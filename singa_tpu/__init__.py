@@ -1,0 +1,24 @@
+"""singa-tpu: a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/pjit re-design with the capabilities of early Apache
+SINGA (the parameter-server-era C++ system): protobuf-text-configured
+feed-forward nets, the full SGD-family updater/schedule vocabulary, a sharded
+record-file data pipeline, and distributed training — except the execution
+engine is one sharded, jit-compiled XLA program over a `jax.sharding.Mesh`
+instead of mshadow kernels stitched together by a ZeroMQ parameter server.
+
+Package map (reference layer in parens, see SURVEY.md):
+  config/    text-proto job configs            (src/proto/*.proto, L8)
+  ops/       JAX functional op vocabulary      (mshadow tensor_expr_ext, L0)
+  layers/    layer registry & implementations  (src/worker/layer.cc, L2)
+  graph/     net DAG build + shape inference   (src/worker/neuralnet.cc, L2)
+  params/    param specs + 6 init methods      (src/utils/param.cc, L4)
+  optim/     5 updaters x 6 LR schedules       (src/utils/updater.cc, L3)
+  data/      shard files, parsers, prefetch    (src/utils/shard.cc, L1/L9)
+  parallel/  mesh, shardings, collectives      (cluster/router/bridges, L7)
+  trainer/   training loop, cadences, ckpt     (src/worker/worker.cc, L5)
+  models/    model family builders             (examples/, L9)
+  utils/     metrics, timers, graph viz        (L9)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,432 @@
+"""Typed job-config schema mirroring the reference proto surface.
+
+Field names, defaults, and enum vocabularies reproduce the reference's
+`src/proto/model.proto` and `src/proto/cluster.proto` so that existing job
+files (e.g. reference examples/mnist/mlp.conf, conv.conf) parse unchanged.
+The schema is implemented as lightweight Python message classes rather than
+generated protobuf code: the text-format front end lives in
+``singa_tpu.config.textproto`` and this module applies typing + defaults.
+
+Enums are represented as strings (the text-format identifiers, e.g.
+``"kSGD"``, ``"MAX"``); constants are provided for comparison.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import textproto
+
+
+# --------------------------------------------------------------------------
+# enum vocabularies (model.proto:40-44,72-92,108-122,251-254,308-335)
+# --------------------------------------------------------------------------
+
+GRAD_CALC_ALGS = ("kBackPropagation", "kContrastiveDivergence")
+INIT_METHODS = (
+    "kConstant",
+    "kGaussain",  # [sic] reference spelling, model.proto:75
+    "kUniform",
+    "kPretrained",
+    "kGaussainSqrtFanIn",
+    "kUniformSqrtFanIn",
+    "kUniformSqrtFanInOut",
+)
+PHASES = ("kTrain", "kValidation", "kTest")
+PARTITION_TYPES = ("kDataPartition", "kLayerPartition", "kNone")
+CONNECTION_TYPES = ("kOneToOne", "kOneToAll")
+POOL_METHODS = ("MAX", "AVE")
+NORM_REGIONS = ("ACROSS_CHANNELS", "WITHIN_CHANNEL")
+UPDATER_TYPES = ("kAdaGrad", "kAdaDelta", "kNesterov", "kSGD", "kRMSProp")
+LR_CHANGE_METHODS = (
+    "kFixed",
+    "kInverse_t",
+    "kInverse",
+    "kExponential",
+    "kLinear",
+    "kStep",
+)
+
+
+class ConfigError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# message machinery
+# --------------------------------------------------------------------------
+
+
+class Field:
+    """One schema field: type, default, repeated-ness, enum/message binding."""
+
+    def __init__(
+        self,
+        kind: str,
+        default: Any = None,
+        *,
+        repeated: bool = False,
+        enum: tuple[str, ...] | None = None,
+        message: type | None = None,
+        required: bool = False,
+    ):
+        assert kind in ("int", "float", "bool", "string", "enum", "message")
+        self.kind = kind
+        self.default = default
+        self.repeated = repeated
+        self.enum = enum
+        self.message = message
+        self.required = required
+
+    def convert(self, raw: Any, name: str) -> Any:
+        k = self.kind
+        if k == "message":
+            if not isinstance(raw, dict):
+                raise ConfigError(f"field {name!r} expects a message block")
+            return self.message.from_fields(raw)
+        if k == "int":
+            if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+                raise ConfigError(f"field {name!r} expects an int, got {raw!r}")
+            return int(raw)
+        if k == "float":
+            if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+                raise ConfigError(f"field {name!r} expects a number, got {raw!r}")
+            return float(raw)
+        if k == "bool":
+            if isinstance(raw, bool):
+                return raw
+            if raw in (0, 1):
+                return bool(raw)
+            raise ConfigError(f"field {name!r} expects a bool, got {raw!r}")
+        if k == "string":
+            if not isinstance(raw, str):
+                raise ConfigError(f"field {name!r} expects a string, got {raw!r}")
+            return raw
+        if k == "enum":
+            if not isinstance(raw, str) or raw not in self.enum:
+                raise ConfigError(
+                    f"field {name!r}: {raw!r} not in enum {self.enum}"
+                )
+            return raw
+        raise AssertionError(k)
+
+
+class Message:
+    """Base for schema messages; subclasses declare FIELDS."""
+
+    FIELDS: dict[str, Field] = {}
+
+    def __init__(self, **kwargs: Any):
+        for fname, spec in self.FIELDS.items():
+            if fname in kwargs:
+                val = kwargs.pop(fname)
+            elif spec.repeated:
+                val = []
+            else:
+                val = spec.default
+            setattr(self, fname, val)
+        if kwargs:
+            raise ConfigError(
+                f"{type(self).__name__}: unknown fields {sorted(kwargs)}"
+            )
+
+    @classmethod
+    def from_fields(cls, raw: dict[str, list[Any]]) -> "Message":
+        out: dict[str, Any] = {}
+        for fname, occurrences in raw.items():
+            spec = cls.FIELDS.get(fname)
+            if spec is None:
+                raise ConfigError(
+                    f"{cls.__name__}: unknown field {fname!r} "
+                    f"(known: {sorted(cls.FIELDS)})"
+                )
+            vals = [spec.convert(v, fname) for v in occurrences]
+            out[fname] = vals if spec.repeated else vals[-1]
+        msg = cls(**out)
+        for fname, spec in cls.FIELDS.items():
+            if spec.required and getattr(msg, fname) is None:
+                raise ConfigError(f"{cls.__name__}: missing required {fname!r}")
+        return msg
+
+    @classmethod
+    def from_text(cls, text: str) -> "Message":
+        return cls.from_fields(textproto.parse(text))
+
+    @classmethod
+    def from_file(cls, path: str) -> "Message":
+        return cls.from_fields(textproto.parse_file(path))
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {}
+        for fname, spec in self.FIELDS.items():
+            v = getattr(self, fname)
+            if spec.kind == "message":
+                if spec.repeated:
+                    v = [m.to_dict() for m in v]
+                elif v is not None:
+                    v = v.to_dict()
+            out[fname] = v
+        return out
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{k}={getattr(self, k)!r}"
+            for k in self.FIELDS
+            if getattr(self, k) not in (None, [])
+        )
+        return f"{type(self).__name__}({inner})"
+
+
+# --------------------------------------------------------------------------
+# per-layer hyper-parameter messages (model.proto:160-275)
+# --------------------------------------------------------------------------
+
+
+class RGBImageConfig(Message):
+    FIELDS = {
+        "scale": Field("float", 1.0),
+        "cropsize": Field("int", 0),
+        "mirror": Field("bool", False),
+    }
+
+
+class SplitConfig(Message):
+    FIELDS = {"num_splits": Field("int")}
+
+
+class TanhConfig(Message):
+    # scaled tanh: outer_scale * tanh(inner_scale * x); defaults are 1.0 but
+    # the reference kTanh layer always uses the LeCun constants (stanh,
+    # cxxnet_op.h:77-87) regardless — see layers/neuron.py.
+    FIELDS = {
+        "outer_scale": Field("float", 1.0),
+        "inner_scale": Field("float", 1.0),
+    }
+
+
+class SoftmaxLossConfig(Message):
+    FIELDS = {
+        "topk": Field("int", 1),
+        "scale": Field("float", 1.0),
+    }
+
+
+class ConvolutionConfig(Message):
+    FIELDS = {
+        "num_filters": Field("int"),
+        "bias_term": Field("bool", True),
+        "pad": Field("int", 0),
+        "stride": Field("int", 1),
+        "kernel": Field("int", required=True),
+    }
+
+
+class ConcateConfig(Message):
+    FIELDS = {
+        "concate_dimension": Field("int"),
+        "concate_num": Field("int"),
+    }
+
+
+class DataConfig(Message):
+    FIELDS = {
+        "source": Field("string"),
+        "path": Field("string"),
+        "batchsize": Field("int"),
+        "random_skip": Field("int", 0),
+    }
+
+
+class MnistConfig(Message):
+    FIELDS = {
+        "kernel": Field("int", 0),
+        "sigma": Field("float", 0.0),
+        "alpha": Field("float", 0.0),
+        "beta": Field("float", 0.0),
+        "gamma": Field("float", 0.0),
+        "resize": Field("int", 0),
+        "elastic_freq": Field("int", 0),
+        "norm_a": Field("float", 1.0),
+        "norm_b": Field("float", 0.0),
+    }
+
+
+class DropoutConfig(Message):
+    FIELDS = {"dropout_ratio": Field("float", 0.5)}
+
+
+class InnerProductConfig(Message):
+    FIELDS = {
+        "num_output": Field("int"),
+        "bias_term": Field("bool", True),
+    }
+
+
+class LRNConfig(Message):
+    FIELDS = {
+        "local_size": Field("int", 5),
+        "alpha": Field("float", 1.0),
+        "beta": Field("float", 0.75),
+        "norm_region": Field("enum", "ACROSS_CHANNELS", enum=NORM_REGIONS),
+        "knorm": Field("float", 1.0),
+    }
+
+
+class PoolingConfig(Message):
+    FIELDS = {
+        "pool": Field("enum", "MAX", enum=POOL_METHODS),
+        "kernel": Field("int", required=True),
+        "pad": Field("int", 0),
+        "stride": Field("int", 1),
+    }
+
+
+class SliceConfig(Message):
+    FIELDS = {
+        "slice_dimension": Field("int"),
+        "slice_num": Field("int"),
+    }
+
+
+class ReLUConfig(Message):
+    FIELDS = {"negative_slope": Field("float", 0.0)}
+
+
+class ParamConfig(Message):
+    FIELDS = {
+        "name": Field("string"),
+        "id": Field("int"),
+        "shape": Field("int", repeated=True),
+        "split_threshold": Field("int", 5000000),
+        "partition_dim": Field("int", -1),
+        "init_method": Field("enum", "kConstant", enum=INIT_METHODS),
+        "value": Field("float", 1.0),
+        "low": Field("float", -1.0),
+        "high": Field("float", 1.0),
+        "mean": Field("float", 0.0),
+        "std": Field("float", 1.0),
+        "learning_rate_multiplier": Field("float", 1.0),
+        "weight_decay_multiplier": Field("float", 1.0),
+    }
+
+
+class LayerConfig(Message):
+    FIELDS = {
+        "name": Field("string"),
+        "type": Field("string"),
+        "srclayers": Field("string", repeated=True),
+        "locationid": Field("int", 0),
+        "partitionid": Field("int", 0),
+        "partition_type": Field("enum", None, enum=PARTITION_TYPES),
+        "share_ary": Field("string", repeated=True),
+        "param": Field("message", repeated=True, message=ParamConfig),
+        "share_param": Field("string", repeated=True),
+        "exclude": Field("enum", repeated=True, enum=PHASES),
+        "convolution_param": Field("message", message=ConvolutionConfig),
+        "concate_param": Field("message", message=ConcateConfig),
+        "data_param": Field("message", message=DataConfig),
+        "dropout_param": Field("message", message=DropoutConfig),
+        "inner_product_param": Field("message", message=InnerProductConfig),
+        "lrn_param": Field("message", message=LRNConfig),
+        "mnist_param": Field("message", message=MnistConfig),
+        "pooling_param": Field("message", message=PoolingConfig),
+        "slice_param": Field("message", message=SliceConfig),
+        "split_param": Field("message", message=SplitConfig),
+        "relu_param": Field("message", message=ReLUConfig),
+        "rgbimage_param": Field("message", message=RGBImageConfig),
+        "softmaxloss_param": Field("message", message=SoftmaxLossConfig),
+        "tanh_param": Field("message", message=TanhConfig),
+    }
+
+
+class NetConfig(Message):
+    FIELDS = {
+        "layer": Field("message", repeated=True, message=LayerConfig),
+        "partition_type": Field("enum", "kNone", enum=PARTITION_TYPES),
+    }
+
+
+class UpdaterConfig(Message):
+    FIELDS = {
+        "type": Field("enum", "kAdaGrad", enum=UPDATER_TYPES),
+        "hogwild": Field("bool", True),
+        "momentum": Field("float", 0.0),
+        "weight_decay": Field("float", 0.0),
+        "gamma": Field("float", 1.0),
+        "pow": Field("float", 0.0),
+        "delta": Field("float", 1e-7),
+        "rho": Field("float", 0.9),
+        "base_learning_rate": Field("float"),
+        "final_learning_rate": Field("float"),
+        "learning_rate_change_frequency": Field("int"),
+        "learning_rate_change_method": Field(
+            "enum", "kFixed", enum=LR_CHANGE_METHODS
+        ),
+        "sync_frequency": Field("int", 1),
+        "warmup_steps": Field("int", 10),
+        "moving_rate": Field("float", 0.0),
+        "param_type": Field("string", "Elastic"),
+    }
+
+
+class ModelConfig(Message):
+    FIELDS = {
+        "name": Field("string"),
+        "train_folder": Field("string", "train"),
+        "test_folder": Field("string", "test"),
+        "validation_folder": Field("string", "validation"),
+        "display_after_steps": Field("int", 0),
+        "display_frequency": Field("int", 0),
+        "validation_after_steps": Field("int", 0),
+        "validation_frequency": Field("int", 0),
+        "test_after_steps": Field("int", 0),
+        "test_frequency": Field("int", 0),
+        "prefetch": Field("bool", True),
+        "train_steps": Field("int"),
+        "validation_steps": Field("int"),
+        "test_steps": Field("int"),
+        "step": Field("int", 0),
+        "updater": Field("message", message=UpdaterConfig),
+        "alg": Field("enum", "kBackPropagation", enum=GRAD_CALC_ALGS),
+        "neuralnet": Field("message", message=NetConfig),
+        "debug": Field("bool", False),
+        # --- singa-tpu extension: checkpoint restore path (fills the
+        # reference's unimplemented Worker::Resume, worker.cc:65-67) ---
+        "checkpoint": Field("string"),
+    }
+
+
+class ClusterConfig(Message):
+    FIELDS = {
+        "nworkers": Field("int"),
+        "nservers": Field("int", 0),
+        "start_port": Field("int", 6723),
+        "nprocs_per_group": Field("int", 1),
+        "nthreads_per_procs": Field("int", 1),
+        "nthreads_per_server": Field("int", 1),
+        "workspace": Field("string", required=True),
+        "vis_subfolder": Field("string", "vis"),
+        "log_subfolder": Field("string", "log"),
+        "synchronous": Field("bool", False),
+        "largest_message": Field("int", 1048576),
+        "bandwidth": Field("float", 100.0),
+    }
+
+    @property
+    def ngroups(self) -> int:
+        """Number of worker groups = data-parallel replicas.
+
+        Reference: include/utils/cluster.h:49-50 — workers are partitioned
+        into groups of ``nprocs_per_group``.
+        """
+        if not self.nworkers:
+            return 1
+        return max(1, self.nworkers // max(1, self.nprocs_per_group))
+
+
+def load_model_config(path: str) -> ModelConfig:
+    return ModelConfig.from_file(path)
+
+
+def load_cluster_config(path: str) -> ClusterConfig:
+    return ClusterConfig.from_file(path)

@@ -1,0 +1,173 @@
+"""Protobuf text-format parser (no protobuf runtime dependency).
+
+The reference system's public API is a pair of text-format protobuf files
+(`model.conf`, `cluster.conf`) read by ``ReadProtoFromTextFile``
+(reference: src/utils/common.cc:56-64). This module parses that syntax into
+plain nested Python structures; ``singa_tpu.config.schema`` then applies
+typed field definitions and defaults.
+
+Supported syntax (everything the reference configs use, plus the common
+text-format extras):
+
+  key: value            # scalar field (int/float/bool/enum-ident/"string")
+  key { ... }           # sub-message
+  key: { ... }          # sub-message, colon form
+  repeated fields       # same key occurring multiple times accumulates
+  # line comments       # anywhere, including inside messages
+
+Values are returned as Python ints/floats/bools/strings; enum identifiers
+(e.g. ``kSGD``, ``MAX``) are returned as strings and resolved by the schema
+layer.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s+
+  | \#[^\n]*                          # comment
+  | (?P<brace>[{}])
+  | (?P<colon>:)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<number>[-+]?(?:\.\d+|\d+\.?\d*)(?:[eE][-+]?\d+)?)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+    """,
+    re.VERBOSE,
+)
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\", "'": "'"}
+
+
+class TextProtoError(ValueError):
+    """Raised on malformed text-format input."""
+
+
+def _unquote(tok: str) -> str:
+    body = tok[1:-1]
+    out = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            nxt = body[i + 1]
+            if nxt in _ESCAPES:
+                out.append(_ESCAPES[nxt])
+                i += 2
+                continue
+            if nxt.isdigit():  # octal escape
+                j = i + 1
+                while j < len(body) and j < i + 4 and body[j].isdigit():
+                    j += 1
+                out.append(chr(int(body[i + 1 : j], 8)))
+                i = j
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def tokenize(text: str) -> list[tuple[str, Any]]:
+    """Lex text-format input into (kind, value) tokens."""
+    tokens: list[tuple[str, Any]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            line = text.count("\n", 0, pos) + 1
+            raise TextProtoError(
+                f"unexpected character {text[pos]!r} at line {line}"
+            )
+        pos = m.end()
+        if m.lastgroup is None:
+            continue  # whitespace / comment
+        val = m.group(m.lastgroup)
+        if m.lastgroup == "string":
+            tokens.append(("string", _unquote(val)))
+        elif m.lastgroup == "number":
+            if re.search(r"[.eE]", val):
+                tokens.append(("number", float(val)))
+            else:
+                tokens.append(("number", int(val)))
+        elif m.lastgroup == "ident":
+            if val == "true":
+                tokens.append(("bool", True))
+            elif val == "false":
+                tokens.append(("bool", False))
+            else:
+                tokens.append(("ident", val))
+        else:
+            tokens.append((m.lastgroup, val))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, Any]]):
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> tuple[str, Any] | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> tuple[str, Any]:
+        tok = self.peek()
+        if tok is None:
+            raise TextProtoError("unexpected end of input")
+        self.pos += 1
+        return tok
+
+    def parse_message(self, *, toplevel: bool = False) -> dict[str, list[Any]]:
+        """Parse fields until '}' (or EOF at top level).
+
+        Every field maps to a *list* of occurrences; the schema layer decides
+        whether a field is repeated (keep the list) or optional (take the
+        last occurrence, matching protobuf text-format merge semantics).
+        """
+        fields: dict[str, list[Any]] = {}
+        while True:
+            tok = self.peek()
+            if tok is None:
+                if toplevel:
+                    return fields
+                raise TextProtoError("unexpected end of input: missing '}'")
+            if tok == ("brace", "}"):
+                if toplevel:
+                    raise TextProtoError("unbalanced '}' at top level")
+                self.next()
+                return fields
+            kind, name = self.next()
+            if kind != "ident":
+                raise TextProtoError(f"expected field name, got {name!r}")
+            tok = self.peek()
+            if tok == ("colon", ":"):
+                self.next()
+                tok = self.peek()
+                if tok == ("brace", "{"):
+                    self.next()
+                    value: Any = self.parse_message()
+                else:
+                    vkind, value = self.next()
+                    if vkind not in ("string", "number", "bool", "ident"):
+                        raise TextProtoError(
+                            f"bad value for field {name!r}: {value!r}"
+                        )
+            elif tok == ("brace", "{"):
+                self.next()
+                value = self.parse_message()
+            else:
+                raise TextProtoError(
+                    f"expected ':' or '{{' after field {name!r}"
+                )
+            fields.setdefault(name, []).append(value)
+
+
+def parse(text: str) -> dict[str, list[Any]]:
+    """Parse text-format protobuf into {field: [occurrences...]}."""
+    return _Parser(tokenize(text)).parse_message(toplevel=True)
+
+
+def parse_file(path: str) -> dict[str, list[Any]]:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse(f.read())
