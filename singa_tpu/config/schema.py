@@ -87,7 +87,13 @@ class Field:
         if k == "int":
             if isinstance(raw, bool) or not isinstance(raw, (int, float)):
                 raise ConfigError(f"field {name!r} expects an int, got {raw!r}")
-            return int(raw)
+            if isinstance(raw, float):
+                # protobuf's text parser rejects any float literal for an
+                # int32 field ("Expected integer, got: 2.0")
+                raise ConfigError(
+                    f"field {name!r} expects an int, got float {raw!r}"
+                )
+            return raw
         if k == "float":
             if isinstance(raw, bool) or not isinstance(raw, (int, float)):
                 raise ConfigError(f"field {name!r} expects a number, got {raw!r}")
@@ -140,8 +146,23 @@ class Message:
                     f"{cls.__name__}: unknown field {fname!r} "
                     f"(known: {sorted(cls.FIELDS)})"
                 )
-            vals = [spec.convert(v, fname) for v in occurrences]
-            out[fname] = vals if spec.repeated else vals[-1]
+            if spec.repeated:
+                out[fname] = [spec.convert(v, fname) for v in occurrences]
+            elif spec.kind == "message" and len(occurrences) > 1:
+                # protobuf text-format merge: duplicate occurrences of a
+                # non-repeated message field merge field-wise (recursively);
+                # concatenating the occurrence lists reproduces that exactly.
+                merged: dict[str, list[Any]] = {}
+                for occ in occurrences:
+                    if not isinstance(occ, dict):
+                        raise ConfigError(
+                            f"field {fname!r} expects a message block"
+                        )
+                    for sub, subvals in occ.items():
+                        merged.setdefault(sub, []).extend(subvals)
+                out[fname] = spec.convert(merged, fname)
+            else:
+                out[fname] = spec.convert(occurrences[-1], fname)
         msg = cls(**out)
         for fname, spec in cls.FIELDS.items():
             if spec.required and getattr(msg, fname) is None:
@@ -339,6 +360,64 @@ class LayerConfig(Message):
     }
 
 
+# --------------------------------------------------------------------------
+# data record messages (model.proto:279-305,342-349)
+# --------------------------------------------------------------------------
+
+RECORD_TYPES = ("kSingleLabelImage",)
+
+
+class SingleLabelImageRecord(Message):
+    """One labelled image sample (model.proto:300-305).
+
+    ``pixel`` holds raw uint8 bytes (decoded from the protobuf bytes field);
+    ``data`` holds float pixels. Exactly one of the two is normally set.
+    """
+
+    FIELDS = {
+        "shape": Field("int", repeated=True),
+        "label": Field("int", 0),
+        "pixel": Field("string", ""),
+        "data": Field("float", repeated=True),
+    }
+
+
+class RecordConfig(Message):
+    """Top-level dataset record (model.proto:279-285)."""
+
+    FIELDS = {
+        "type": Field("enum", "kSingleLabelImage", enum=RECORD_TYPES),
+        "image": Field("message", message=SingleLabelImageRecord),
+    }
+
+
+class DatumConfig(Message):
+    """Caffe LMDB record for import (model.proto:288-299)."""
+
+    FIELDS = {
+        "channels": Field("int", 0),
+        "height": Field("int", 0),
+        "width": Field("int", 0),
+        "data": Field("string", ""),
+        "label": Field("int", 0),
+        "float_data": Field("float", repeated=True),
+        "encoded": Field("bool", False),
+    }
+
+
+class BlobConfig(Message):
+    """Tensor snapshot message (model.proto:342-349); used by checkpoints."""
+
+    FIELDS = {
+        "num": Field("int", 0),
+        "channels": Field("int", 0),
+        "height": Field("int", 0),
+        "width": Field("int", 0),
+        "data": Field("float", repeated=True),
+        "diff": Field("float", repeated=True),
+    }
+
+
 class NetConfig(Message):
     FIELDS = {
         "layer": Field("message", repeated=True, message=LayerConfig),
@@ -417,11 +496,19 @@ class ClusterConfig(Message):
         """Number of worker groups = data-parallel replicas.
 
         Reference: include/utils/cluster.h:49-50 — workers are partitioned
-        into groups of ``nprocs_per_group``.
+        into groups of ``nprocs_per_group`` (plain integer division). A
+        config with nworkers < nprocs_per_group would yield zero groups in
+        the reference and silently do nothing; we reject it explicitly.
         """
         if not self.nworkers:
             return 1
-        return max(1, self.nworkers // max(1, self.nprocs_per_group))
+        npg = max(1, self.nprocs_per_group)
+        if self.nworkers < npg:
+            raise ConfigError(
+                f"nworkers ({self.nworkers}) < nprocs_per_group ({npg}): "
+                "yields zero worker groups"
+            )
+        return self.nworkers // npg
 
 
 def load_model_config(path: str) -> ModelConfig:

@@ -57,11 +57,12 @@ def _unquote(tok: str) -> str:
                 out.append(_ESCAPES[nxt])
                 i += 2
                 continue
-            if nxt.isdigit():  # octal escape
+            if nxt in "01234567":  # octal escape (max 3 octal digits)
                 j = i + 1
-                while j < len(body) and j < i + 4 and body[j].isdigit():
+                while j < len(body) and j < i + 4 and body[j] in "01234567":
                     j += 1
-                out.append(chr(int(body[i + 1 : j], 8)))
+                # protobuf truncates a 3-digit octal escape to one byte
+                out.append(chr(int(body[i + 1 : j], 8) & 0xFF))
                 i = j
                 continue
         out.append(c)
@@ -122,8 +123,9 @@ class _Parser:
         """Parse fields until '}' (or EOF at top level).
 
         Every field maps to a *list* of occurrences; the schema layer decides
-        whether a field is repeated (keep the list) or optional (take the
-        last occurrence, matching protobuf text-format merge semantics).
+        whether a field is repeated (keep the list), a scalar (take the last
+        occurrence), or a non-repeated message (merge occurrences field-wise,
+        matching protobuf text-format merge semantics).
         """
         fields: dict[str, list[Any]] = {}
         while True:

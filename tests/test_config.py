@@ -170,3 +170,63 @@ def test_reference_style_lmdb_layer_parses():
     l = cfg.neuralnet.layer[0]
     assert l.type == "kLMDBData"
     assert l.data_param.random_skip == 10000
+
+
+def test_int_field_rejects_float_literal():
+    # protobuf text parser rejects any float literal for an int32 field;
+    # 64.9 must not silently truncate to 64 (ADVICE r1).
+    with pytest.raises(ConfigError):
+        ModelConfig.from_text("train_steps: 2.7")
+    with pytest.raises(ConfigError):
+        ModelConfig.from_text("train_steps: 2.0")
+
+
+def test_duplicate_message_field_merges_fieldwise():
+    # protobuf text-format merges duplicate non-repeated message fields
+    # field-wise instead of last-wins (ADVICE r1).
+    cfg = ModelConfig.from_text(
+        "updater { momentum: 0.9 }\nupdater { gamma: 0.1 }"
+    )
+    assert cfg.updater.momentum == pytest.approx(0.9)
+    assert cfg.updater.gamma == pytest.approx(0.1)
+
+
+def test_octal_escape_limits():
+    from singa_tpu.config.textproto import parse as tp_parse
+
+    # \101 = 'A'; a following 8 is a literal char, not part of the octal
+    assert tp_parse(r'p: "\1018"')["p"] == ["A8"]
+    # '\48' : 8 is not an octal digit -> \4 then literal '8'
+    assert tp_parse(r'p: "\48"')["p"] == ["\x048"]
+    # 3-digit octal escapes truncate to one byte like protobuf's tokenizer
+    assert tp_parse(r'p: "\777"')["p"] == ["\xff"]
+
+
+def test_ngroups_rejects_undersized_worker_count():
+    cfg = ClusterConfig.from_text(
+        'nworkers: 2\nnprocs_per_group: 4\nworkspace: "/tmp/ws"'
+    )
+    with pytest.raises(ConfigError):
+        cfg.ngroups
+
+
+def test_record_schema_messages():
+    from singa_tpu.config.schema import BlobConfig, DatumConfig, RecordConfig
+
+    rec = RecordConfig.from_text(
+        """
+        type: kSingleLabelImage
+        image { shape: 28 shape: 28 label: 7 data: 0.5 data: 0.25 }
+        """
+    )
+    assert rec.type == "kSingleLabelImage"
+    assert rec.image.shape == [28, 28]
+    assert rec.image.label == 7
+    assert rec.image.data == [0.5, 0.25]
+
+    d = DatumConfig.from_text("channels: 3 height: 2 width: 2 label: 1")
+    assert (d.channels, d.height, d.width, d.label) == (3, 2, 2, 1)
+    assert d.encoded is False
+
+    b = BlobConfig.from_text("num: 1 channels: 1 height: 2 width: 2 data: 1.0")
+    assert b.data == [1.0]
