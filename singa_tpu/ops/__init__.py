@@ -1,0 +1,45 @@
+"""Functional op vocabulary (the reference's L0 math layer, TPU-native).
+
+Everything the reference computes with mshadow expression templates
+(include/mshadow/tensor_expr_ext.h, cxxnet_op.h) is expressed here as pure
+jnp/lax functions that XLA fuses and tiles onto the MXU/VPU. There is no
+backward vocabulary: gradients come from jax autodiff, and the unit tests pin
+``jax.grad`` of each forward op to the reference's hand-written *_grad
+formulas.
+"""
+
+from .activations import (
+    bnll,
+    relu,
+    sigmoid,
+    softplus,
+    stanh,
+    STANH_INNER,
+    STANH_OUTER,
+)
+from .nn import (
+    avg_pool2d,
+    conv2d,
+    dropout,
+    lrn,
+    max_pool2d,
+    pooled_size,
+    softmax_loss,
+)
+
+__all__ = [
+    "bnll",
+    "relu",
+    "sigmoid",
+    "softplus",
+    "stanh",
+    "STANH_INNER",
+    "STANH_OUTER",
+    "avg_pool2d",
+    "conv2d",
+    "dropout",
+    "lrn",
+    "max_pool2d",
+    "pooled_size",
+    "softmax_loss",
+]

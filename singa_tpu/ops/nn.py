@@ -1,0 +1,156 @@
+"""NN ops: convolution, pooling, LRN, dropout, softmax loss.
+
+These replace the reference's mshadow DNN vocabulary
+(include/mshadow/tensor_expr_ext.h:354-577) with XLA-native lowerings:
+im2col+gemm becomes ``lax.conv_general_dilated`` (tiled straight onto the
+MXU), pool/unpool become ``lax.reduce_window`` + autodiff, chpool becomes a
+channel-axis reduce_window. All arrays are NCHW to match the reference's
+layout contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    stride: int = 1,
+    pad: int = 0,
+    precision=lax.Precision.HIGHEST,
+) -> jnp.ndarray:
+    """2-D convolution over NCHW input.
+
+    Matches ConvolutionLayer::ComputeFeature (reference:
+    src/worker/layer.cc:63-83): out = weight @ im2col(pad(x)) + bias, where
+    ``weight`` may be given either as (F, C*k*k) — the reference's col-matrix
+    layout — or as (F, C, k, k). mshadow's unpack_patch2col row ordering is
+    (c, kh, kw) row-major, so the reshape is exactly OIHW.
+
+    ``precision`` defaults to HIGHEST because the reference accumulates in
+    fp32 (cblas_sgemm); pass ``lax.Precision.DEFAULT`` (bf16 MXU passes) on
+    the perf path when parity tolerances allow.
+    """
+    if weight.ndim == 2:
+        nf = weight.shape[0]
+        c = x.shape[1]
+        k = int(round((weight.shape[1] // c) ** 0.5))
+        weight = weight.reshape(nf, c, k, k)
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=precision,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def pooled_size(size: int, kernel: int, stride: int) -> int:
+    """Reference pooling output size — ceil mode, window may overhang
+    (src/worker/layer.cc:496-500): ceil((size - kernel)/stride) + 1."""
+    return -((size - kernel) // -stride) + 1
+
+
+def _pool(x: jnp.ndarray, kernel: int, stride: int, init, op):
+    # Pad bottom/right so the ceil-mode window arithmetic becomes VALID.
+    b, c, h, w = x.shape
+    ph = (pooled_size(h, kernel, stride) - 1) * stride + kernel
+    pw = (pooled_size(w, kernel, stride) - 1) * stride + kernel
+    return lax.reduce_window(
+        x,
+        init,
+        op,
+        window_dimensions=(1, 1, kernel, kernel),
+        window_strides=(1, 1, stride, stride),
+        padding=[(0, 0), (0, 0), (0, ph - h), (0, pw - w)],
+    )
+
+
+def max_pool2d(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+    """pool<red::maximum> (reference: layer.cc:514-516)."""
+    return _pool(x, kernel, stride, -jnp.inf, lax.max)
+
+
+def avg_pool2d(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+    """pool<red::sum> * 1/k^2 (reference: layer.cc:517-519 — divides by the
+    full kernel area even for overhanging border windows)."""
+    return _pool(x, kernel, stride, 0.0, lax.add) * (1.0 / (kernel * kernel))
+
+
+def lrn(
+    x: jnp.ndarray,
+    *,
+    local_size: int = 5,
+    alpha: float = 1.0,
+    beta: float = 0.75,
+    knorm: float = 1.0,
+) -> jnp.ndarray:
+    """Cross-channel local response normalization.
+
+    Matches LRNLayer::ComputeFeature (reference: src/worker/layer.cc:356-365):
+    norm = chpool_sum(x^2, local_size) * (alpha/local_size) + knorm;
+    out = x * norm^(-beta). The channel window is centered with zero padding
+    (mshadow chpool, tensor_expr_ext.h:553).
+    """
+    salpha = alpha / local_size
+    half = local_size // 2
+    sq = jnp.square(x)
+    window_sum = lax.reduce_window(
+        sq,
+        0.0,
+        lax.add,
+        window_dimensions=(1, local_size, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=[(0, 0), (half, half), (0, 0), (0, 0)],
+    )
+    norm = window_sum * salpha + knorm
+    return x * jnp.power(norm, -beta)
+
+
+def dropout(
+    rng: jax.Array, x: jnp.ndarray, pdrop: float, training: bool
+) -> jnp.ndarray:
+    """Inverted-scale Bernoulli dropout.
+
+    Matches DropoutLayer::ComputeFeature (reference: layer.cc:144-155):
+    mask = (uniform < pkeep) / pkeep; out = x * mask.
+    """
+    if not training or pdrop <= 0.0:
+        return x
+    pkeep = 1.0 - pdrop
+    mask = (jax.random.uniform(rng, x.shape) < pkeep).astype(x.dtype) / pkeep
+    return x * mask
+
+
+def softmax_loss(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    topk: int = 1,
+    scale: float = 1.0,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Softmax + cross-entropy + top-k precision in one op.
+
+    Matches SoftmaxLossLayer (reference: src/worker/layer.cc:718-764):
+    metric[0] = scale * mean(-log p_true), metric[1] = scale * mean(top-k
+    hit). ``jax.grad`` of the returned loss wrt logits is exactly the
+    reference's hand-written gradient (prob - onehot) * scale / batchsize.
+    """
+    labels = labels.astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    n = logits.shape[0]
+    true_logp = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    loss = -jnp.mean(true_logp) * scale
+    _, top_idx = lax.top_k(logits, topk)
+    hit = jnp.any(top_idx == labels[:, None], axis=-1)
+    precision = jnp.mean(hit.astype(jnp.float32)) * scale
+    return loss, {"loss": loss, "precision": precision}

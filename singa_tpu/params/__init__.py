@@ -1,0 +1,143 @@
+"""Parameter specs, initialization, and sharing.
+
+Replaces the reference's Param class (include/utils/param.h,
+src/utils/param.cc). A parameter here is a plain jnp array living in a
+name-keyed pytree; this module carries the *metadata* the reference attached
+to each Param — init method + hyperparams, per-param learning-rate /
+weight-decay multipliers, fan-in, and sharing (owner) links — and implements
+the 6 init methods with the reference's exact fan-in scaling rules
+(src/utils/param.cc:61-99).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ConfigError, ParamConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Static metadata for one parameter tensor.
+
+    ``fan_in`` follows the reference's per-layer conventions: for an FC
+    weight the *total size* vdim*hdim (layer.cc:178), for a conv weight the
+    col height channels*k*k (layer.cc:49), 0 for biases.
+    """
+
+    name: str
+    shape: tuple[int, ...]
+    init_method: str = "kConstant"
+    value: float = 1.0
+    low: float = -1.0
+    high: float = 1.0
+    mean: float = 0.0
+    std: float = 1.0
+    lr_mult: float = 1.0
+    wd_mult: float = 1.0
+    fan_in: int = 0
+    owner: str | None = None  # share_param: alias of another param's storage
+
+    @classmethod
+    def from_config(
+        cls,
+        cfg: ParamConfig | None,
+        name: str,
+        shape: tuple[int, ...],
+        fan_in: int = 0,
+        owner: str | None = None,
+    ) -> "ParamSpec":
+        if cfg is None:
+            return cls(name=name, shape=shape, fan_in=fan_in, owner=owner)
+        return cls(
+            name=name,
+            shape=shape,
+            init_method=cfg.init_method,
+            value=cfg.value,
+            low=cfg.low,
+            high=cfg.high,
+            mean=cfg.mean,
+            std=cfg.std,
+            lr_mult=cfg.learning_rate_multiplier,
+            wd_mult=cfg.weight_decay_multiplier,
+            fan_in=fan_in,
+            owner=owner,
+        )
+
+
+def init_param(rng: jax.Array, spec: ParamSpec) -> jnp.ndarray:
+    """Materialize one parameter per its init method.
+
+    Mirrors Param::Init (reference: src/utils/param.cc:61-99) including the
+    quirky scaling rules: every random method multiplies by ``value`` when
+    nonzero, and the SqrtFanIn family divides that scale by the respective
+    sqrt term. RNG parity with the reference is distributional, not bitwise
+    (it seeds C rand() with wall-clock time).
+    """
+    shape = spec.shape
+    m = spec.init_method
+    if m == "kConstant":
+        return jnp.full(shape, spec.value, dtype=jnp.float32)
+    if m == "kUniform":
+        x = jax.random.uniform(
+            rng, shape, minval=spec.low, maxval=spec.high, dtype=jnp.float32
+        )
+        return x * spec.value if spec.value else x
+    if m == "kUniformSqrtFanIn":
+        if spec.fan_in <= 0:
+            raise ConfigError(f"param {spec.name!r}: kUniformSqrtFanIn needs fan_in>0")
+        x = jax.random.uniform(
+            rng, shape, minval=spec.low, maxval=spec.high, dtype=jnp.float32
+        )
+        if spec.value:
+            x = x * (spec.value / jnp.sqrt(spec.fan_in / 3.0))
+        return x
+    if m == "kUniformSqrtFanInOut":
+        x = jax.random.uniform(
+            rng, shape, minval=spec.low, maxval=spec.high, dtype=jnp.float32
+        )
+        if spec.value:
+            x = x * (spec.value / jnp.sqrt(shape[0] + shape[1]))
+        return x
+    if m == "kGaussain":  # [sic] reference spelling
+        x = spec.mean + spec.std * jax.random.normal(rng, shape, dtype=jnp.float32)
+        return x * spec.value if spec.value else x
+    if m == "kGaussainSqrtFanIn":
+        x = spec.mean + spec.std * jax.random.normal(rng, shape, dtype=jnp.float32)
+        if spec.value:
+            x = x * (spec.value / jnp.sqrt(shape[0]))
+        return x
+    if m == "kPretrained":
+        # Resolved by the checkpoint restore path (trainer/checkpoint.py),
+        # which fills these from ModelConfig.checkpoint before training.
+        return jnp.zeros(shape, dtype=jnp.float32)
+    raise ConfigError(f"param {spec.name!r}: unknown init method {m!r}")
+
+
+def init_params(
+    rng: jax.Array, specs: dict[str, ParamSpec]
+) -> dict[str, jnp.ndarray]:
+    """Materialize a name-keyed param pytree.
+
+    Shared params (spec.owner set) alias their owner's array, mirroring
+    Param::ShareData (reference: include/utils/param.h:55-73).
+    """
+    owners = {n: s for n, s in specs.items() if s.owner is None}
+    keys = jax.random.split(rng, max(len(owners), 1))
+    out: dict[str, jnp.ndarray] = {}
+    for key, (name, spec) in zip(keys, sorted(owners.items())):
+        out[name] = init_param(key, spec)
+    for name, spec in specs.items():
+        if spec.owner is not None:
+            if spec.owner not in out:
+                raise ConfigError(
+                    f"param {name!r} shares unknown owner {spec.owner!r}"
+                )
+            if specs[spec.owner].shape != spec.shape:
+                raise ConfigError(
+                    f"param {name!r} shares {spec.owner!r} with mismatched shape"
+                )
+    return out

@@ -6,7 +6,9 @@ bench.py) — the flags must be set before jax is first imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pre-sets a real accelerator platform
+# (e.g. JAX_PLATFORMS=axon for the tunneled TPU, reserved for bench.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

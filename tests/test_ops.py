@@ -1,0 +1,186 @@
+"""Op-vocabulary numeric tests.
+
+Each test pins a singa_tpu.ops function (and where relevant its jax.grad)
+against the reference's mshadow formula, re-derived independently in numpy
+(reference: include/mshadow/cxxnet_op.h, tensor_expr_ext.h,
+src/worker/layer.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu import ops
+
+
+def test_relu_and_grad():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.5, 3.0])
+    np.testing.assert_allclose(ops.relu(x), [0, 0, 0, 0.5, 3.0])
+    # relu_grad(a) = a > 0 ? 1 : 0 applied to the *output* (cxxnet_op.h:31-35)
+    g = jax.grad(lambda v: ops.relu(v).sum())(x)
+    np.testing.assert_allclose(g, [0, 0, 0, 1, 1])
+
+
+def test_leaky_relu():
+    x = jnp.array([-2.0, 4.0])
+    np.testing.assert_allclose(ops.relu(x, negative_slope=0.1), [-0.2, 4.0])
+
+
+def test_stanh_constants():
+    # stanh(x) = 1.7159047 * tanh(0.66666667 * x), cxxnet_op.h:77-80
+    x = np.linspace(-3, 3, 11).astype(np.float32)
+    expected = 1.7159047 * np.tanh(0.66666667 * x)
+    np.testing.assert_allclose(ops.stanh(jnp.array(x)), expected, rtol=1e-4)
+
+
+def test_stanh_grad_matches_reference_formula():
+    # reference backward (cxxnet_op.h:82-86) is written in terms of the
+    # *output* a: g = 0.66666667*1.7159047 - 0.66666667/1.7159047 * a^2
+    x = jnp.array([-1.5, -0.2, 0.0, 0.7, 2.0])
+    a = np.asarray(ops.stanh(x))
+    expected = 0.66666667 * 1.7159047 - 0.66666667 / 1.7159047 * a * a
+    g = jax.grad(lambda v: ops.stanh(v).sum())(x)
+    np.testing.assert_allclose(g, expected, rtol=1e-4)
+
+
+def test_sigmoid_and_grad():
+    x = jnp.array([-2.0, 0.0, 1.0])
+    s = 1.0 / (1.0 + np.exp(-np.asarray(x)))
+    np.testing.assert_allclose(ops.sigmoid(x), s, rtol=1e-6)
+    # sigmoid_grad(a) = a*(1-a) on the output (cxxnet_op.h:19-23)
+    g = jax.grad(lambda v: ops.sigmoid(v).sum())(x)
+    np.testing.assert_allclose(g, s * (1 - s), rtol=1e-6)
+
+
+def test_softplus_bnll():
+    x = jnp.array([-30.0, -1.0, 0.0, 1.0, 30.0])
+    np.testing.assert_allclose(
+        ops.softplus(x), np.log1p(np.exp(np.asarray(x))), rtol=1e-5
+    )
+    # bnll is the overflow-safe softplus; identical values where both stable
+    np.testing.assert_allclose(ops.bnll(x)[1:4], ops.softplus(x)[1:4], rtol=1e-5)
+    assert float(ops.bnll(jnp.array([100.0]))[0]) == pytest.approx(100.0)
+
+
+def _ref_conv(x, w4, b, stride, pad):
+    """Direct im2col+gemm like ConvolutionLayer (layer.cc:63-83)."""
+    n, c, h, wd = x.shape
+    f, _, k, _ = w4.shape
+    if pad:
+        x = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    oh = (h + 2 * pad - k) // stride + 1
+    ow = (wd + 2 * pad - k) // stride + 1
+    out = np.zeros((n, f, oh, ow), np.float32)
+    for ni in range(n):
+        for fi in range(f):
+            for oi in range(oh):
+                for oj in range(ow):
+                    patch = x[ni, :, oi * stride : oi * stride + k,
+                              oj * stride : oj * stride + k]
+                    out[ni, fi, oi, oj] = np.sum(patch * w4[fi]) + b[fi]
+    return out
+
+
+def test_conv2d_matches_im2col_gemm():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    w4 = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    for stride, pad in [(1, 0), (2, 1), (1, 2)]:
+        expected = _ref_conv(x, w4, b, stride, pad)
+        got = ops.conv2d(jnp.array(x), jnp.array(w4), jnp.array(b),
+                         stride=stride, pad=pad)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+    # the reference's 2-D (F, C*k*k) weight layout gives the same answer
+    w2 = w4.reshape(4, -1)
+    got2 = ops.conv2d(jnp.array(x), jnp.array(w2), jnp.array(b), stride=1, pad=0)
+    np.testing.assert_allclose(got2, _ref_conv(x, w4, b, 1, 0), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_pooled_size_ceil_mode():
+    # layer.cc:496-500: pooled = ceil((size - kernel)/stride) + 1
+    assert ops.pooled_size(28, 2, 2) == 14
+    assert ops.pooled_size(5, 2, 2) == 3  # ceil(3/2)+1 — window overhangs
+    assert ops.pooled_size(7, 3, 2) == 3
+
+
+def _ref_pool(x, k, s, mode):
+    n, c, h, w = x.shape
+    oh, ow = ops.pooled_size(h, k, s), ops.pooled_size(w, k, s)
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for oi in range(oh):
+        for oj in range(ow):
+            win = x[:, :, oi * s : oi * s + k, oj * s : oj * s + k]
+            if mode == "max":
+                out[:, :, oi, oj] = win.max(axis=(2, 3))
+            else:  # reference divides by full k*k even for partial windows
+                out[:, :, oi, oj] = win.sum(axis=(2, 3)) / (k * k)
+    return out
+
+
+def test_pooling_matches_reference():
+    rng = np.random.RandomState(1)
+    for h in (6, 7):  # 7 exercises the overhanging ceil-mode window
+        x = rng.randn(2, 3, h, h).astype(np.float32)
+        np.testing.assert_allclose(
+            ops.max_pool2d(jnp.array(x), 2, 2), _ref_pool(x, 2, 2, "max"),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            ops.avg_pool2d(jnp.array(x), 2, 2), _ref_pool(x, 2, 2, "avg"),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_matches_chpool_formula():
+    # layer.cc:356-365: norm = chpool_sum(x^2,l)*alpha/l + knorm; x*norm^-beta
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 8, 3, 3).astype(np.float32)
+    lsize, alpha, beta, knorm = 5, 1e-4, 0.75, 1.0
+    half = lsize // 2
+    norm = np.zeros_like(x)
+    for c in range(8):
+        lo, hi = max(0, c - half), min(8, c + half + 1)
+        norm[:, c] = (x[:, lo:hi] ** 2).sum(axis=1) * (alpha / lsize) + knorm
+    expected = x * norm ** (-beta)
+    got = ops.lrn(jnp.array(x), local_size=lsize, alpha=alpha, beta=beta,
+                  knorm=knorm)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_dropout_scaling_and_eval_passthrough():
+    x = jnp.ones((1000,))
+    key = jax.random.PRNGKey(0)
+    y = ops.dropout(key, x, 0.25, training=True)
+    kept = np.asarray(y) > 0
+    # inverted scaling: kept entries equal 1/pkeep
+    np.testing.assert_allclose(np.asarray(y)[kept], 1.0 / 0.75, rtol=1e-6)
+    assert 0.6 < kept.mean() < 0.9
+    np.testing.assert_array_equal(ops.dropout(key, x, 0.25, training=False), x)
+
+
+def test_softmax_loss_metrics_and_grad():
+    logits = jnp.array([[2.0, 1.0, 0.1], [0.0, 3.0, -1.0]])
+    labels = jnp.array([0, 2])
+    scale = 2.0
+    loss, metrics = ops.softmax_loss(logits, labels, topk=1, scale=scale)
+    p = np.exp(np.asarray(logits))
+    p /= p.sum(axis=1, keepdims=True)
+    expected_loss = -(np.log(p[0, 0]) + np.log(p[1, 2])) / 2 * scale
+    assert float(loss) == pytest.approx(expected_loss, rel=1e-5)
+    # sample 0 predicted correctly (argmax=0), sample 1 not (argmax=1)
+    assert float(metrics["precision"]) == pytest.approx(0.5 * scale)
+    # gradient == (prob - onehot) * scale / batchsize (layer.cc:754-764)
+    g = jax.grad(lambda l: ops.softmax_loss(l, labels, scale=scale)[0])(logits)
+    onehot = np.zeros_like(p)
+    onehot[0, 0] = onehot[1, 2] = 1
+    np.testing.assert_allclose(g, (p - onehot) * scale / 2, rtol=1e-5)
+
+
+def test_topk_precision():
+    logits = jnp.array([[0.1, 0.5, 0.4], [0.9, 0.05, 0.05]])
+    labels = jnp.array([2, 0])
+    _, m1 = ops.softmax_loss(logits, labels, topk=1)
+    _, m2 = ops.softmax_loss(logits, labels, topk=2)
+    assert float(m1["precision"]) == pytest.approx(0.5)
+    assert float(m2["precision"]) == pytest.approx(1.0)

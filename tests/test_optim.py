@@ -1,0 +1,191 @@
+"""Updater exact-math tests vs reference src/utils/updater.cc:11-182.
+
+Each test re-derives the C++ recurrence in numpy and checks the jitted
+updater reproduces it step for step, including the weight-decay ordering
+quirks and AdaDelta's lr-free update.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import ConfigError, UpdaterConfig
+from singa_tpu.optim import learning_rate, make_updater
+from singa_tpu.params import ParamSpec
+
+
+def _cfg(**kw):
+    kw.setdefault("base_learning_rate", 0.1)
+    return UpdaterConfig(**kw)
+
+
+def _run(updater, data0, grads_per_step, specs=None, nsteps=None):
+    params = {"w": jnp.array(data0, dtype=jnp.float32)}
+    specs = specs or {"w": ParamSpec(name="w", shape=np.shape(data0))}
+    state = updater.init_state(params)
+    apply = jax.jit(
+        lambda s, p, g, st: updater.apply(s, p, g, st, specs)
+    )
+    outs = []
+    for step, g in enumerate(grads_per_step[:nsteps]):
+        params, state = apply(step, params, {"w": jnp.asarray(g, jnp.float32)}, state)
+        outs.append(np.asarray(params["w"]))
+    return outs, state
+
+
+# ---------------------------- LR schedules ----------------------------
+
+
+def test_lr_fixed():
+    cfg = _cfg(learning_rate_change_method="kFixed")
+    assert float(learning_rate(cfg, 100)) == pytest.approx(0.1)
+
+
+def test_lr_linear():
+    cfg = _cfg(learning_rate_change_method="kLinear",
+               learning_rate_change_frequency=100, final_learning_rate=0.01)
+    # (1 - r)*base + r*final with r = step/freq
+    assert float(learning_rate(cfg, 50)) == pytest.approx(0.5 * 0.1 + 0.5 * 0.01)
+
+
+def test_lr_exponential():
+    cfg = _cfg(learning_rate_change_method="kExponential",
+               learning_rate_change_frequency=10, final_learning_rate=0.05)
+    assert float(learning_rate(cfg, 15)) == pytest.approx(0.1 / 2 ** 1.5, rel=1e-5)
+    bad = _cfg(learning_rate_change_method="kExponential",
+               learning_rate_change_frequency=10, final_learning_rate=0.01)
+    with pytest.raises(ConfigError):
+        learning_rate(bad, 0)
+
+
+def test_lr_inverse_t():
+    cfg = _cfg(learning_rate_change_method="kInverse_t",
+               final_learning_rate=0.05)
+    assert float(learning_rate(cfg, 7)) == pytest.approx(0.1 / (1 + 7 / 0.05),
+                                                         rel=1e-4)
+
+
+def test_lr_inverse():
+    cfg = _cfg(learning_rate_change_method="kInverse", gamma=0.5, pow=0.75)
+    assert float(learning_rate(cfg, 4)) == pytest.approx(
+        0.1 * (1 + 0.5 * 4) ** -0.75, rel=1e-5)
+
+
+def test_lr_step_integer_division():
+    cfg = _cfg(learning_rate_change_method="kStep", gamma=0.5,
+               learning_rate_change_frequency=60)
+    # "notice it is step/change_steps, not step*1.0/change_steps"
+    assert float(learning_rate(cfg, 59)) == pytest.approx(0.1)
+    assert float(learning_rate(cfg, 60)) == pytest.approx(0.05)
+    assert float(learning_rate(cfg, 125)) == pytest.approx(0.025)
+
+
+# ---------------------------- updaters ----------------------------
+
+
+def test_sgd_plain():
+    u = make_updater(_cfg(type="kSGD"))
+    outs, _ = _run(u, [1.0, -2.0], [[0.5, 0.5], [0.5, 0.5]])
+    np.testing.assert_allclose(outs[0], [0.95, -2.05], rtol=1e-6)
+    np.testing.assert_allclose(outs[1], [0.90, -2.10], rtol=1e-6)
+
+
+def test_sgd_momentum_and_weight_decay():
+    lr, m, wd = 0.1, 0.9, 0.01
+    u = make_updater(_cfg(type="kSGD", momentum=m, weight_decay=wd))
+    grads = [[0.5], [0.25], [-0.1]]
+    data, h = np.array([1.0]), np.array([0.0])
+    expect = []
+    for g in grads:
+        g = np.array(g) + wd * data  # L2 folded into grad (updater.cc:69-71)
+        h = h * m + lr * g
+        data = data - h
+        expect.append(data.copy())
+    outs, _ = _run(u, [1.0], grads)
+    np.testing.assert_allclose(outs, expect, rtol=1e-5)
+
+
+def test_sgd_lr_wd_multipliers():
+    u = make_updater(_cfg(type="kSGD", weight_decay=0.01))
+    specs = {"w": ParamSpec(name="w", shape=(1,), lr_mult=2.0, wd_mult=0.0)}
+    outs, _ = _run(u, [1.0], [[0.5]], specs=specs)
+    # lr doubled, weight decay zeroed by multiplier
+    np.testing.assert_allclose(outs[0], [1.0 - 0.2 * 0.5], rtol=1e-6)
+
+
+def test_nesterov():
+    lr, m = 0.1, 0.9
+    u = make_updater(_cfg(type="kNesterov", momentum=m))
+    grads = [[0.5], [0.25]]
+    data, h = np.array([1.0]), np.array([0.0])
+    expect = []
+    for g in grads:
+        tmp = h.copy()
+        h = h * m + lr * np.array(g)
+        upd = h * (1 + m) - tmp * m
+        data = data - upd
+        expect.append(data.copy())
+    outs, _ = _run(u, [1.0], grads)
+    np.testing.assert_allclose(outs, expect, rtol=1e-5)
+
+
+def test_adagrad_history_excludes_weight_decay():
+    lr, wd, delta = 0.1, 0.1, 1e-7
+    u = make_updater(_cfg(type="kAdaGrad", weight_decay=wd, delta=delta))
+    grads = [[0.5], [0.3]]
+    data, h = np.array([2.0]), np.array([0.0])
+    expect = []
+    for g in grads:
+        g = np.array(g)
+        h = h + g * g          # pre-decay grad into history (updater.cc:117)
+        g = g + wd * data      # decay folded after
+        data = data - lr * g / np.sqrt(h + delta)
+        expect.append(data.copy())
+    outs, _ = _run(u, [2.0], grads)
+    np.testing.assert_allclose(outs, expect, rtol=1e-5)
+
+
+def test_rmsprop():
+    lr, rho, delta = 0.1, 0.9, 1e-7
+    u = make_updater(_cfg(type="kRMSProp", rho=rho, delta=delta))
+    grads = [[0.5], [0.3], [0.8]]
+    data, h = np.array([1.0]), np.array([0.0])
+    expect = []
+    for g in grads:
+        g = np.array(g)
+        h = h * rho + (1 - rho) * g * g
+        data = data - lr * g / np.sqrt(h + delta)
+        expect.append(data.copy())
+    outs, _ = _run(u, [1.0], grads)
+    np.testing.assert_allclose(outs, expect, rtol=1e-5)
+
+
+def test_adadelta_ignores_learning_rate():
+    rho, delta = 0.9, 1e-6
+    # no base_learning_rate at all — AdaDelta must not require it
+    u = make_updater(UpdaterConfig(type="kAdaDelta", rho=rho, delta=delta))
+    grads = [[0.5], [0.3]]
+    data, h, upd = np.array([1.0]), np.array([0.0]), np.array([0.0])
+    expect = []
+    for g in grads:
+        g = np.array(g)
+        h = h * rho + (1 - rho) * g * g
+        tmp = g * np.sqrt(upd + delta) / np.sqrt(h + delta)
+        upd = rho * upd + (1 - rho) * tmp * tmp
+        data = data - tmp
+        expect.append(data.copy())
+    outs, _ = _run(u, [1.0], grads)
+    np.testing.assert_allclose(outs, expect, rtol=1e-4)
+
+
+def test_updater_requires_positive_lr():
+    with pytest.raises(ConfigError):
+        make_updater(UpdaterConfig(type="kSGD"))
+
+
+def test_unknown_updater_type_rejected():
+    cfg = UpdaterConfig(base_learning_rate=0.1)
+    cfg.type = "kMagic"
+    with pytest.raises(ConfigError):
+        make_updater(cfg)

@@ -1,0 +1,108 @@
+"""Param init tests vs reference src/utils/param.cc:51-99.
+
+RNG parity with the reference is distributional (it seeds C rand() with
+wall-clock time), so tests assert ranges / moments / scale factors, not bits.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import ConfigError, ParamConfig
+from singa_tpu.params import ParamSpec, init_param, init_params
+
+KEY = jax.random.PRNGKey(42)
+
+
+def test_constant():
+    x = init_param(KEY, ParamSpec(name="b", shape=(5,), init_method="kConstant",
+                                  value=0.25))
+    np.testing.assert_allclose(x, 0.25)
+
+
+def test_uniform_range_and_value_scale():
+    spec = ParamSpec(name="w", shape=(2000,), init_method="kUniform",
+                     low=-0.05, high=0.05, value=1.0)
+    x = np.asarray(init_param(KEY, spec))
+    assert x.min() >= -0.05 and x.max() <= 0.05
+    assert abs(x.mean()) < 0.005
+    # value scales the sample (param.cc:71-73)
+    x2 = np.asarray(init_param(KEY, ParamSpec(name="w", shape=(2000,),
+                                              init_method="kUniform",
+                                              low=-0.05, high=0.05, value=2.0)))
+    np.testing.assert_allclose(x2, x * 2.0, rtol=1e-6)
+
+
+def test_uniform_sqrt_fan_in():
+    # scale = value / sqrt(fan_in / 3)  (param.cc:75-79)
+    fan_in = 300
+    base = ParamSpec(name="w", shape=(4000,), init_method="kUniform",
+                     low=-1.0, high=1.0)
+    scaled = ParamSpec(name="w", shape=(4000,), init_method="kUniformSqrtFanIn",
+                       low=-1.0, high=1.0, fan_in=fan_in)
+    a = np.asarray(init_param(KEY, base))
+    b = np.asarray(init_param(KEY, scaled))
+    np.testing.assert_allclose(b, a / np.sqrt(fan_in / 3.0), rtol=1e-5)
+
+
+def test_uniform_sqrt_fan_in_requires_fan_in():
+    with pytest.raises(ConfigError):
+        init_param(KEY, ParamSpec(name="w", shape=(4,),
+                                  init_method="kUniformSqrtFanIn"))
+
+
+def test_uniform_sqrt_fan_in_out():
+    # scale = value / sqrt(shape[0] + shape[1])  (param.cc:80-84)
+    spec = ParamSpec(name="w", shape=(30, 70), init_method="kUniformSqrtFanInOut",
+                     low=-1.0, high=1.0)
+    base = ParamSpec(name="w", shape=(30, 70), init_method="kUniform",
+                     low=-1.0, high=1.0)
+    a = np.asarray(init_param(KEY, base))
+    b = np.asarray(init_param(KEY, spec))
+    np.testing.assert_allclose(b, a / 10.0, rtol=1e-5)
+
+
+def test_gaussian_moments_and_fan_in_scale():
+    spec = ParamSpec(name="w", shape=(20000,), init_method="kGaussain",
+                     mean=1.0, std=0.5)
+    x = np.asarray(init_param(KEY, spec))
+    assert x.mean() == pytest.approx(1.0, abs=0.02)
+    assert x.std() == pytest.approx(0.5, abs=0.02)
+    # kGaussainSqrtFanIn divides by sqrt(shape[0])  (param.cc:90-94)
+    s2 = ParamSpec(name="w", shape=(100, 200), init_method="kGaussainSqrtFanIn",
+                   mean=0.0, std=1.0)
+    y = np.asarray(init_param(KEY, s2))
+    assert y.std() == pytest.approx(1.0 / 10.0, abs=0.01)
+
+
+def test_value_zero_disables_scaling():
+    # `if (proto_.value())` — a zero value skips the scale entirely
+    spec = ParamSpec(name="w", shape=(1000,), init_method="kUniformSqrtFanIn",
+                     low=-1.0, high=1.0, value=0.0, fan_in=100)
+    base = ParamSpec(name="w", shape=(1000,), init_method="kUniform",
+                     low=-1.0, high=1.0, value=0.0)
+    np.testing.assert_allclose(init_param(KEY, spec), init_param(KEY, base))
+
+
+def test_from_config_multipliers():
+    cfg = ParamConfig(name="w", init_method="kUniform", low=-0.1, high=0.1,
+                      learning_rate_multiplier=2.0, weight_decay_multiplier=0.0)
+    spec = ParamSpec.from_config(cfg, "conv1.weight", (20, 25), fan_in=25)
+    assert spec.lr_mult == 2.0 and spec.wd_mult == 0.0
+    assert spec.init_method == "kUniform" and spec.fan_in == 25
+
+
+def test_init_params_sharing():
+    specs = {
+        "a": ParamSpec(name="a", shape=(3,), init_method="kConstant", value=7.0),
+        "b": ParamSpec(name="b", shape=(3,), owner="a"),
+    }
+    out = init_params(KEY, specs)
+    assert "a" in out and "b" not in out  # b aliases a's storage
+    with pytest.raises(ConfigError):
+        init_params(KEY, {"b": ParamSpec(name="b", shape=(3,), owner="zzz")})
+    with pytest.raises(ConfigError):
+        init_params(KEY, {
+            "a": ParamSpec(name="a", shape=(3,)),
+            "b": ParamSpec(name="b", shape=(4,), owner="a"),
+        })
