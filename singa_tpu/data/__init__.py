@@ -1,0 +1,22 @@
+"""Data subsystem: record codec, shard files, dataset loaders, input pipeline.
+
+Replaces the reference's L1/L9 data path — shard::Shard record files
+(src/utils/shard.cc), protobuf Record values (src/proto/model.proto:279-305),
+the data_loader tool (tools/data_loader/) and the prefetching data layers
+(include/worker/base_layer.h:335-560) — with a host-side pipeline that feeds
+device arrays to the jitted train step.
+"""
+
+from .records import ImageRecord, decode_record, encode_record
+from .shard import ShardReader, ShardWriter
+from .pipeline import BatchPipeline, load_shard_arrays
+
+__all__ = [
+    "ImageRecord",
+    "decode_record",
+    "encode_record",
+    "ShardReader",
+    "ShardWriter",
+    "BatchPipeline",
+    "load_shard_arrays",
+]

@@ -1,0 +1,187 @@
+"""Dataset -> shard converter CLI (the reference's build/loader).
+
+Mirrors tools/data_loader/ semantics: shards are opened in append mode so a
+crashed run resumes where it stopped (data_loader.cc:12-14,122), and MNIST
+idx files are parsed with the same big-endian magic/meta layout
+(data_source.cc:25-95). Keys are zero-padded record indices.
+
+Sources:
+  mnist      train/test idx file pairs -> pixel-bytes records (shape 28x28)
+  digits     sklearn load_digits upscaled to 28x28 — a real, learnable
+             stand-in when the MNIST files aren't on disk (this image has no
+             network egress); accuracy-parity tests train on this
+  synthetic  deterministic Gaussian-blob classes, for benchmarks/smoke tests
+
+Usage:
+  python -m singa_tpu.data.loader mnist  --image-file f --label-file f --output DIR
+  python -m singa_tpu.data.loader digits --output DIR [--split train|test]
+  python -m singa_tpu.data.loader synthetic --output DIR --n 1000 [--classes 10]
+  python -m singa_tpu.data.loader split --input DIR --prefix P --n N [--mode equal|head]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+
+import numpy as np
+
+from .records import ImageRecord, encode_record
+from .shard import ShardReader, ShardWriter
+
+
+def _key(i: int) -> str:
+    return f"{i:08d}"
+
+
+def write_records(
+    folder: str, images: np.ndarray, labels: np.ndarray, append: bool = True
+) -> int:
+    """Write uint8 (N,H,W) images + labels as Records; returns #inserted."""
+    images = np.asarray(images, dtype=np.uint8)
+    n = 0
+    with ShardWriter(folder, append=append) as w:
+        for i, (img, label) in enumerate(zip(images, labels)):
+            rec = ImageRecord(
+                shape=list(img.shape), label=int(label), pixel=img.tobytes()
+            )
+            if w.insert(_key(i), encode_record(rec)):
+                n += 1
+        w.flush()
+    return n
+
+
+# ---------------------------- sources ----------------------------
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    """Parse an MNIST idx3-ubyte image file (data_source.cc:31-54)."""
+    with open(path, "rb") as f:
+        magic, num, h, w = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad image magic {magic} (want 2051)")
+        buf = f.read(num * h * w)
+    return np.frombuffer(buf, dtype=np.uint8).reshape(num, h, w)
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad label magic {magic} (want 2049)")
+        buf = f.read(num)
+    return np.frombuffer(buf, dtype=np.uint8)
+
+
+def digits_arrays(split: str = "train") -> tuple[np.ndarray, np.ndarray]:
+    """sklearn 8x8 digits, nearest-upscaled to 28x28 uint8 images."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    images = (d.images / d.images.max() * 255.0).astype(np.uint8)
+    # 8x8 -> 32x32 via kron, center-crop to 28x28
+    big = np.kron(images, np.ones((1, 4, 4), dtype=np.uint8))
+    big = big[:, 2:30, 2:30]
+    labels = d.target.astype(np.uint8)
+    # deterministic 80/20 split, interleaved so class balance holds
+    test_mask = np.arange(len(big)) % 5 == 4
+    if split == "test":
+        return big[test_mask], labels[test_mask]
+    return big[~test_mask], labels[~test_mask]
+
+
+def synthetic_arrays(
+    n: int, classes: int = 10, size: int = 28, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Gaussian class-template blobs: learnable, deterministic, no IO."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(classes, size, size) * 160.0
+    labels = (np.arange(n) % classes).astype(np.uint8)
+    noise = rng.rand(n, size, size) * 95.0
+    images = (templates[labels] + noise).clip(0, 255).astype(np.uint8)
+    return images, labels
+
+
+# ---------------------------- split (reference Split/SplitN) -----------
+
+
+def split_shard(input_dir: str, prefix: str, n: int, mode: str = "equal"):
+    with ShardReader(input_dir) as reader:
+        tuples = list(reader)
+    total = len(tuples)
+    if mode == "equal":
+        if n >= total:
+            raise ValueError("too many sub-shards")
+        sizes = [total // n + (total % n if i == 0 else 0) for i in range(n)]
+        pos = 0
+        for i, sz in enumerate(sizes):
+            with ShardWriter(f"{prefix}-{i}", append=True) as w:
+                for k, v in tuples[pos : pos + sz]:
+                    w.insert(k, v)
+                w.flush()
+            pos += sz
+    else:  # head: first n records into -0, rest into -1
+        if n >= total:
+            raise ValueError("sub shard must be smaller than original")
+        for i, chunk in enumerate((tuples[:n], tuples[n:])):
+            with ShardWriter(f"{prefix}-{i}", append=True) as w:
+                for k, v in chunk:
+                    w.insert(k, v)
+                w.flush()
+
+
+# ---------------------------- CLI ----------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="singa_tpu.data.loader")
+    sub = ap.add_subparsers(dest="source", required=True)
+
+    p = sub.add_parser("mnist")
+    p.add_argument("--image-file", required=True)
+    p.add_argument("--label-file", required=True)
+    p.add_argument("--output", required=True)
+
+    p = sub.add_parser("digits")
+    p.add_argument("--output", required=True)
+    p.add_argument("--split", choices=("train", "test"), default="train")
+
+    p = sub.add_parser("synthetic")
+    p.add_argument("--output", required=True)
+    p.add_argument("--n", type=int, default=1000)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--size", type=int, default=28)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("split")
+    p.add_argument("--input", required=True)
+    p.add_argument("--prefix", required=True)
+    p.add_argument("--n", type=int, required=True)
+    p.add_argument("--mode", choices=("equal", "head"), default="equal")
+
+    args = ap.parse_args(argv)
+    if args.source == "mnist":
+        images = read_idx_images(args.image_file)
+        labels = read_idx_labels(args.label_file)
+        if len(images) != len(labels):
+            raise ValueError("image/label count mismatch")
+        n = write_records(args.output, images, labels)
+    elif args.source == "digits":
+        n = write_records(args.output, *digits_arrays(args.split))
+    elif args.source == "synthetic":
+        n = write_records(
+            args.output,
+            *synthetic_arrays(args.n, args.classes, args.size, args.seed),
+        )
+    else:
+        split_shard(args.input, args.prefix, args.n, args.mode)
+        print(f"split {args.input} -> {args.prefix}-*")
+        return 0
+    print(f"inserted {n} records into {os.path.join(args.output, 'shard.dat')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

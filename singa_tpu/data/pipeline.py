@@ -1,0 +1,107 @@
+"""Host-side input pipeline: shard -> batched numpy arrays -> device.
+
+Replaces the reference's DataLayer/prefetch machinery
+(ShardDataLayer::ComputeFeature, src/worker/layer.cc:646-673; the
+double-buffered ParserLayer::Prefetching protocol,
+include/worker/base_layer.h:510-537). Parsing/normalization itself is NOT
+done here — parser layers are elementwise math and live inside the jitted
+step where XLA fuses them for free; this pipeline just delivers raw record
+batches with the reference's sequencing semantics (sequential reads with
+wraparound, one-time random_skip) plus a background prefetch thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .records import decode_record
+from .shard import ShardReader
+
+
+def load_shard_arrays(folder: str) -> tuple[np.ndarray, np.ndarray]:
+    """Decode every record in a shard into (images, labels) arrays.
+
+    Images come back as float32 with the record's own shape appended after
+    the batch dim; uint8 ``pixel`` payloads are widened (the reference's
+    cast-to-uint8-then-float dance, layer.cc:390-400).
+    """
+    images: list[np.ndarray] = []
+    labels: list[int] = []
+    with ShardReader(folder) as reader:
+        for _, val in reader:
+            rec = decode_record(val)
+            shape = tuple(rec.shape) if rec.shape else (-1,)
+            if rec.pixel:
+                img = np.frombuffer(rec.pixel, dtype=np.uint8).astype(
+                    np.float32
+                )
+            else:
+                img = np.asarray(rec.data, dtype=np.float32)
+            images.append(img.reshape(shape))
+            labels.append(rec.label)
+    if not images:
+        raise ValueError(f"shard {folder!r} holds no records")
+    return np.stack(images), np.asarray(labels, dtype=np.int32)
+
+
+class BatchPipeline:
+    """Batched sequential iteration with wraparound and prefetch.
+
+    Mirrors ShardDataLayer semantics: records are consumed in file order,
+    wrapping at the end; ``random_skip`` skips ``rand() % random_skip``
+    records once at startup (layer.cc:646-656). ``prefetch`` overlaps the
+    next batch's host work with device compute via a daemon thread (the
+    reference's Prefetching protocol).
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batchsize: int,
+        *,
+        random_skip: int = 0,
+        prefetch: bool = True,
+        seed: int | None = None,
+    ):
+        if len(images) != len(labels):
+            raise ValueError("images/labels length mismatch")
+        self.images = images
+        self.labels = labels
+        self.batchsize = batchsize
+        self.n = len(images)
+        self._pos = 0
+        if random_skip:
+            rng = np.random.RandomState(seed)
+            self._pos = int(rng.randint(0, random_skip)) % self.n
+        self._prefetch = prefetch
+        self._queue: queue.Queue | None = None
+        self._thread: threading.Thread | None = None
+
+    def _next_indices(self) -> np.ndarray:
+        idx = (self._pos + np.arange(self.batchsize)) % self.n
+        self._pos = int((self._pos + self.batchsize) % self.n)
+        return idx
+
+    def next_batch(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._prefetch:
+            if self._queue is None:
+                self._queue = queue.Queue(maxsize=2)
+                self._thread = threading.Thread(
+                    target=self._producer, daemon=True
+                )
+                self._thread.start()
+            return self._queue.get()
+        idx = self._next_indices()
+        return self.images[idx], self.labels[idx]
+
+    def _producer(self) -> None:
+        while True:
+            idx = self._next_indices()
+            self._queue.put((self.images[idx], self.labels[idx]))
+
+    def steps_per_epoch(self) -> int:
+        return max(1, self.n // self.batchsize)

@@ -1,0 +1,191 @@
+"""Protobuf wire-format codec for dataset records (no protobuf dependency).
+
+The reference stores each sample as a binary-serialized ``Record`` proto
+inside shard.dat (src/utils/shard.cc:43-47). For byte compatibility with
+shards written by the reference's loader, this module hand-implements the
+proto2 wire format for exactly these messages (src/proto/model.proto:279-305):
+
+    message Record { optional Type type=1; optional SingleLabelImageRecord image=2; }
+    message SingleLabelImageRecord {
+      repeated int32 shape=1; optional int32 label=2;
+      optional bytes pixel=3; repeated float data=4;
+    }
+
+The encoder writes canonical proto2 (unpacked repeated fields, ascending
+field order); the decoder additionally accepts packed repeated encodings and
+unknown fields, like any conforming proto2 reader.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+
+class RecordError(ValueError):
+    pass
+
+
+RECORD_TYPE_SINGLE_LABEL_IMAGE = 0
+
+
+@dataclasses.dataclass
+class ImageRecord:
+    """Decoded Record(kSingleLabelImage): the payload of one sample."""
+
+    shape: list[int] = dataclasses.field(default_factory=list)
+    label: int = 0
+    pixel: bytes = b""  # raw uint8 pixels (exclusive with `data`)
+    data: list[float] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------- varint / tags ----------------------------
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    if value < 0:
+        value += 1 << 64  # proto2 int32: negatives as 10-byte two's complement
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise RecordError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift >= 64:
+            raise RecordError("varint too long")
+
+
+def _int32(value: int) -> int:
+    value &= (1 << 64) - 1
+    if value >= 1 << 63:
+        value -= 1 << 64
+    return int(value)
+
+
+def _skip_field(buf: bytes, pos: int, wire_type: int) -> int:
+    if wire_type == 0:
+        _, pos = _read_varint(buf, pos)
+    elif wire_type == 1:
+        pos += 8
+    elif wire_type == 2:
+        ln, pos = _read_varint(buf, pos)
+        pos += ln
+    elif wire_type == 5:
+        pos += 4
+    else:
+        raise RecordError(f"unsupported wire type {wire_type}")
+    if pos > len(buf):
+        raise RecordError("truncated field")
+    return pos
+
+
+# ---------------------------- encode ----------------------------
+
+
+def _encode_image(rec: ImageRecord) -> bytes:
+    out = bytearray()
+    for s in rec.shape:
+        out.append(0x08)  # field 1, varint
+        _write_varint(out, s)
+    out.append(0x10)  # field 2, varint
+    _write_varint(out, rec.label)
+    if rec.pixel:
+        out.append(0x1A)  # field 3, bytes
+        _write_varint(out, len(rec.pixel))
+        out.extend(rec.pixel)
+    for f in rec.data:
+        out.append(0x25)  # field 4, fixed32
+        out.extend(struct.pack("<f", f))
+    return bytes(out)
+
+
+def encode_record(rec: ImageRecord) -> bytes:
+    """Serialize Record{type=kSingleLabelImage, image=rec} to proto2 bytes."""
+    img = _encode_image(rec)
+    out = bytearray()
+    out.append(0x08)  # Record.type, field 1 varint
+    _write_varint(out, RECORD_TYPE_SINGLE_LABEL_IMAGE)
+    out.append(0x12)  # Record.image, field 2 length-delimited
+    _write_varint(out, len(img))
+    out.extend(img)
+    return bytes(out)
+
+
+# ---------------------------- decode ----------------------------
+
+
+def _decode_image(buf: bytes) -> ImageRecord:
+    rec = ImageRecord()
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field == 1 and wt == 0:
+            v, pos = _read_varint(buf, pos)
+            rec.shape.append(_int32(v))
+        elif field == 1 and wt == 2:  # packed repeated int32
+            ln, pos = _read_varint(buf, pos)
+            end = pos + ln
+            while pos < end:
+                v, pos = _read_varint(buf, pos)
+                rec.shape.append(_int32(v))
+        elif field == 2 and wt == 0:
+            v, pos = _read_varint(buf, pos)
+            rec.label = _int32(v)
+        elif field == 3 and wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            rec.pixel = buf[pos : pos + ln]
+            pos += ln
+        elif field == 4 and wt == 5:
+            rec.data.append(struct.unpack_from("<f", buf, pos)[0])
+            pos += 4
+        elif field == 4 and wt == 2:  # packed repeated float
+            ln, pos = _read_varint(buf, pos)
+            if ln % 4:
+                raise RecordError("bad packed float length")
+            rec.data.extend(
+                struct.unpack_from(f"<{ln // 4}f", buf, pos)
+            )
+            pos += ln
+        else:
+            pos = _skip_field(buf, pos, wt)
+    return rec
+
+
+def decode_record(buf: bytes) -> ImageRecord:
+    """Parse a serialized Record; returns its SingleLabelImageRecord."""
+    rtype = RECORD_TYPE_SINGLE_LABEL_IMAGE
+    image: ImageRecord | None = None
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field == 1 and wt == 0:
+            rtype, pos = _read_varint(buf, pos)
+        elif field == 2 and wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            image = _decode_image(buf[pos : pos + ln])
+            pos += ln
+        else:
+            pos = _skip_field(buf, pos, wt)
+    if rtype != RECORD_TYPE_SINGLE_LABEL_IMAGE:
+        raise RecordError(f"unsupported Record.type {rtype}")
+    if image is None:
+        raise RecordError("Record has no image payload")
+    return image
