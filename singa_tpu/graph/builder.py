@@ -1,0 +1,199 @@
+"""Neural-net DAG builder.
+
+Replaces NeuralNet::ConstructNeuralNet (reference:
+src/worker/neuralnet.cc:72-110) and the Worker's phase filtering
+(src/worker/worker.cc:69-95): layers are filtered by ``exclude`` for the
+requested phase, topo-sorted from their ``srclayers`` edges, instantiated
+through the registry, and shape-inferred in order. The partition rewriter
+(PartitionNeuralNet, neuralnet.cc:112-323) has NO counterpart here by
+design — partitioning is expressed as GSPMD shardings over the unmodified
+graph (see singa_tpu.parallel), which is the entire point of the TPU-native
+re-design.
+
+``Net.forward`` is a pure function of (params, batch, rng) and is traced
+into the jitted train step; the reference's Forward hot loop
+(worker.cc:240-268) with its bridge spins and WaitUpdate blocking dissolves
+into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ConfigError, LayerConfig, ModelConfig, NetConfig
+from ..layers import Layer, create_layer
+from ..layers.connector import SliceLayer
+from ..params import ParamSpec
+
+PHASES = ("kTrain", "kValidation", "kTest")
+
+
+def topo_sort(configs: list[LayerConfig]) -> list[LayerConfig]:
+    """Kahn's algorithm over srclayers edges, stable wrt config order
+    (the reference DFS-sorts in Graph::Sort, src/utils/graph.cc:80-101)."""
+    by_name = {c.name: c for c in configs}
+    if len(by_name) != len(configs):
+        names = [c.name for c in configs]
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ConfigError(f"duplicate layer names after phase filter: {dupes}")
+    indeg = {c.name: 0 for c in configs}
+    for c in configs:
+        for src in c.srclayers:
+            if src not in by_name:
+                raise ConfigError(
+                    f"layer {c.name!r} references unknown srclayer {src!r}"
+                )
+            indeg[c.name] += 1
+    order: list[LayerConfig] = []
+    ready = [c for c in configs if indeg[c.name] == 0]
+    while ready:
+        cur = ready.pop(0)
+        order.append(cur)
+        for c in configs:
+            if cur.name in c.srclayers:
+                indeg[c.name] -= 1
+                if indeg[c.name] == 0:
+                    ready.append(c)
+    if len(order) != len(configs):
+        stuck = sorted(set(by_name) - {c.name for c in order})
+        raise ConfigError(f"cycle in layer graph involving {stuck}")
+    return order
+
+
+class Net:
+    """An ordered, shape-inferred layer DAG for one phase."""
+
+    def __init__(self, layers: list[Layer], phase: str):
+        self.phase = phase
+        self.layers = layers
+        self.name2layer = {l.name: l for l in layers}
+        self.datalayers = [l for l in layers if l.is_datalayer]
+        self.parserlayers = [l for l in layers if l.is_parserlayer]
+        self.losslayers = [l for l in layers if l.is_losslayer]
+        # consumer lists drive Slice output routing (k-th dst gets slice k,
+        # reference base_layer.cc:136-151)
+        self.dstlayers: dict[str, list[str]] = {l.name: [] for l in layers}
+        for l in layers:
+            for src in l.srclayers:
+                self.dstlayers[src].append(l.name)
+
+    # ---------------- build ----------------
+
+    def setup(self) -> None:
+        shapes: dict[str, tuple] = {}
+        batchsize = 0
+        for layer in self.layers:
+            src_shapes = [shapes[s] for s in layer.srclayers]
+            out = layer.setup(src_shapes, batchsize)
+            if layer.is_datalayer:
+                batchsize = layer.batchsize
+            if isinstance(layer, SliceLayer):
+                # consumers each see one slice
+                shapes[layer.name] = out
+            else:
+                shapes[layer.name] = out
+            layer.out_shape = out
+        self.batchsize = batchsize
+
+    def param_specs(self) -> dict[str, ParamSpec]:
+        specs: dict[str, ParamSpec] = {}
+        for layer in self.layers:
+            for name, spec in layer.param_specs().items():
+                if name in specs:
+                    raise ConfigError(f"duplicate param name {name!r}")
+                specs[name] = spec
+        return specs
+
+    # ---------------- trace ----------------
+
+    def forward(
+        self,
+        params: dict[str, jnp.ndarray],
+        batch: dict[str, Any],
+        *,
+        training: bool,
+        rng: jax.Array | None = None,
+    ) -> tuple[jnp.ndarray, dict[str, dict[str, jnp.ndarray]]]:
+        """Run all layers; returns (total_loss, {losslayer: metrics}).
+
+        ``batch`` maps each data layer's name to its input dict
+        ({"image": ..., "label": ...}); shared params resolve through their
+        owner's array (ParamSpec.owner).
+        """
+        resolved = dict(params)
+        for layer in self.layers:
+            for name, spec in layer.param_specs().items():
+                if spec.owner is not None:
+                    resolved[name] = params[spec.owner]
+
+        acts: dict[str, Any] = {}
+        slice_cursor: dict[str, int] = {}
+        total_loss = jnp.float32(0.0)
+        metrics: dict[str, dict[str, jnp.ndarray]] = {}
+        for i, layer in enumerate(self.layers):
+            if layer.is_datalayer:
+                inputs = [batch[layer.name]]
+            else:
+                inputs = []
+                for src in layer.srclayers:
+                    val = acts[src]
+                    if isinstance(self.name2layer.get(src), SliceLayer):
+                        k = slice_cursor.get(src, 0)
+                        slice_cursor[src] = k + 1
+                        val = val[k]
+                    inputs.append(val)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            out = layer.apply(resolved, inputs, training=training, rng=lrng)
+            if layer.is_losslayer:
+                loss, m = out
+                total_loss = total_loss + loss
+                metrics[layer.name] = m
+                acts[layer.name] = loss
+            else:
+                acts[layer.name] = out
+        return total_loss, metrics
+
+    # ---------------- observability ----------------
+
+    def to_json(self) -> dict:
+        """Node-link dump matching NeuralNet::ToString's shape
+        (reference: neuralnet.cc:325-332, src/utils/graph.cc:8-59)."""
+        nodes = [
+            {
+                "id": l.name,
+                "type": l.TYPE,
+                "shape": list(l.out_shape or ()),
+                "partition_dim": l.partition_dim,
+            }
+            for l in self.layers
+        ]
+        links = [
+            {"source": src, "target": l.name}
+            for l in self.layers
+            for src in l.srclayers
+        ]
+        return {"phase": self.phase, "nodes": nodes, "links": links}
+
+
+def filter_phase(net_cfg: NetConfig, phase: str) -> list[LayerConfig]:
+    """Drop layers whose ``exclude`` lists the phase (worker.cc:69-95)."""
+    if phase not in PHASES:
+        raise ConfigError(f"unknown phase {phase!r}")
+    return [l for l in net_cfg.layer if phase not in (l.exclude or [])]
+
+
+def build_net(model_cfg: ModelConfig, phase: str = "kTrain") -> Net:
+    """Config -> phase-filtered, topo-sorted, shape-inferred Net."""
+    if model_cfg.neuralnet is None:
+        raise ConfigError("model config has no neuralnet block")
+    configs = filter_phase(model_cfg.neuralnet, phase)
+    if not configs:
+        raise ConfigError(f"no layers left for phase {phase}")
+    order = topo_sort(configs)
+    net_partition = model_cfg.neuralnet.partition_type
+    net = Net([create_layer(c, net_partition) for c in order], phase)
+    net.setup()
+    return net

@@ -1,0 +1,100 @@
+"""Layer abstraction.
+
+The reference's Layer (include/worker/base_layer.h:38-258) is a stateful
+object with Setup/ComputeFeature/ComputeGradient over owned blobs. Here a
+layer is *static metadata + a pure function*: ``setup`` runs shape inference
+and declares param specs once at graph-build time; ``apply`` is traced into
+the single jitted train step, so there is no ComputeGradient — jax autodiff
+provides it. Partition metadata (partition_dimension, connection_type,
+base_layer.h:121-140) is kept so the parallel package can map it to GSPMD
+shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ConfigError, LayerConfig
+from ..params import ParamSpec
+
+Shape = tuple[int, ...]
+
+
+class Layer:
+    """Base class; subclasses set TYPE and override setup/apply."""
+
+    TYPE: str = ""
+    # partition_dimension(): 0 = batch (kDataPartition), 1 = neuron
+    # (kLayerPartition), -1 = unpartitionable (base_layer.h:121-128)
+    PARTITION_DIM_FOR = {"kDataPartition": 0, "kLayerPartition": 1, "kNone": -1}
+    # connection_type(): kOneToOne (elementwise) unless overridden
+    CONNECTION = "kOneToOne"
+
+    is_datalayer = False
+    is_parserlayer = False
+    is_losslayer = False
+    is_connectorlayer = False
+
+    def __init__(self, cfg: LayerConfig, net_partition: str = "kNone"):
+        self.cfg = cfg
+        self.name = cfg.name
+        self.srclayers: list[str] = list(cfg.srclayers)
+        self.partition_type = cfg.partition_type or net_partition
+        self.out_shape: Shape | None = None
+        self._param_specs: dict[str, ParamSpec] = {}
+
+    # ---------------- build time ----------------
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        """Infer the output shape and declare params; returns out_shape."""
+        raise NotImplementedError
+
+    def param_specs(self) -> dict[str, ParamSpec]:
+        """Qualified-name -> spec, declared during setup."""
+        return self._param_specs
+
+    def _declare_param(
+        self, idx: int, default_name: str, shape: Shape, fan_in: int = 0
+    ) -> str:
+        """Register param ``<layer>/<name>`` from cfg.param[idx] (if given)."""
+        cfg = self.cfg.param[idx] if idx < len(self.cfg.param) else None
+        pname = (cfg.name if cfg and cfg.name else default_name)
+        qualified = f"{self.name}/{pname}"
+        share = list(self.cfg.share_param)
+        owner = share[idx] if idx < len(share) else None
+        self._param_specs[qualified] = ParamSpec.from_config(
+            cfg, qualified, tuple(shape), fan_in=fan_in, owner=owner
+        )
+        return qualified
+
+    @property
+    def partition_dim(self) -> int:
+        return self.PARTITION_DIM_FOR[self.partition_type]
+
+    # ---------------- trace time ----------------
+
+    def apply(
+        self,
+        params: dict[str, jnp.ndarray],
+        inputs: list[Any],
+        *,
+        training: bool,
+        rng: jax.Array | None = None,
+    ) -> Any:
+        """Pure forward; traced inside the jitted step."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name!r}, out={self.out_shape})"
+
+
+def require_one_src(layer: Layer, src_shapes: Sequence[Shape]) -> Shape:
+    if len(src_shapes) != 1:
+        raise ConfigError(
+            f"layer {layer.name!r} ({layer.TYPE}) expects exactly one "
+            f"srclayer, got {len(src_shapes)}"
+        )
+    return src_shapes[0]

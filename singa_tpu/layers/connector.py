@@ -1,0 +1,107 @@
+"""Connector layers: Slice, Concate, Split, BridgeSrc/BridgeDst.
+
+In the reference these are the partition plumbing: the graph rewriter
+inserts them to split/concatenate blobs across intra-group partitions and
+to ship activations between processes over ZeroMQ
+(src/worker/neuralnet.cc:198-323, src/worker/base_layer.cc:39-191). In the
+TPU-native design that role is played by GSPMD: sharding annotations make
+XLA insert the equivalent collectives inside the one compiled program. The
+layers still exist so (a) reference job files that name them parse and run,
+and (b) explicit in-graph slice/concat dataflow keeps working.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..config.schema import ConfigError
+from .base import Layer, Shape, require_one_src
+
+
+class SliceLayer(Layer):
+    """kSlice (reference: base_layer.cc:114-173): split the input into
+    slice_num equal parts along slice_dimension; output k feeds the k-th
+    dstlayer. The reference gives the last partition the remainder
+    (base_layer.cc:127-128); XLA wants even shards, so we require even
+    divisibility and say so (SURVEY hard-part #3)."""
+
+    TYPE = "kSlice"
+    is_connectorlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.slice_param
+        if p is None or not p.slice_num:
+            raise ConfigError(f"layer {self.name!r}: slice_param required")
+        self.dim, self.num = p.slice_dimension, p.slice_num
+        src = require_one_src(self, src_shapes)
+        if src[self.dim] % self.num:
+            raise ConfigError(
+                f"layer {self.name!r}: dim {self.dim} size {src[self.dim]} "
+                f"not divisible by slice_num {self.num} (XLA shards evenly; "
+                "pad or round the net width)"
+            )
+        out = list(src)
+        out[self.dim] //= self.num
+        return tuple(out)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        return jnp.split(inputs[0], self.num, axis=self.dim)
+
+
+class ConcateLayer(Layer):
+    """kConcate (reference: base_layer.cc:85-110; its compute is a stub —
+    ours is real)."""
+
+    TYPE = "kConcate"
+    is_connectorlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.concate_param
+        if p is None:
+            raise ConfigError(f"layer {self.name!r}: concate_param required")
+        self.dim = p.concate_dimension
+        out = list(src_shapes[0])
+        out[self.dim] = sum(s[self.dim] for s in src_shapes)
+        return tuple(out)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        return jnp.concatenate(inputs, axis=self.dim)
+
+
+class SplitLayer(Layer):
+    """kSplit (reference: base_layer.cc:175-191): fan the same blob out to
+    num_splits consumers. Identity in a functional graph."""
+
+    TYPE = "kSplit"
+    is_connectorlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        return require_one_src(self, src_shapes)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        return inputs[0]
+
+
+class _BridgeLayer(Layer):
+    """Bridges became XLA resharding: inside one jitted program a
+    location-crossing edge is just an array with a different sharding, so
+    both bridge halves are identity. Kept for job-file parity
+    (base_layer.h:264-312)."""
+
+    is_connectorlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        return require_one_src(self, src_shapes)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        return inputs[0]
+
+
+class BridgeSrcLayer(_BridgeLayer):
+    TYPE = "kBridgeSrc"
+
+
+class BridgeDstLayer(_BridgeLayer):
+    TYPE = "kBridgeDst"

@@ -1,0 +1,158 @@
+"""Data + parser layers.
+
+Data layers (kShardData, kLMDBData) are the host/device boundary: at build
+time they open their source to learn the sample shape (exactly like
+ShardDataLayer::Setup reading one record, reference layer.cc:662-672), and at
+run time the trainer feeds their batches in as jitted-step inputs. Their
+``apply`` just forwards that external input.
+
+Parser layers (kMnistImage, kRGBImage, kLabel) are elementwise math and run
+*inside* the jitted step where XLA fuses them (the reference runs them on the
+prefetch thread, base_layer.h:469-560).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ConfigError
+from ..data.pipeline import load_shard_arrays
+from .base import Layer, Shape
+
+
+class ShardDataLayer(Layer):
+    """kShardData (reference: layer.cc:646-673)."""
+
+    TYPE = "kShardData"
+    is_datalayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.data_param
+        if p is None or not p.path or not p.batchsize:
+            raise ConfigError(
+                f"layer {self.name!r}: data_param.path and batchsize required"
+            )
+        self.path = p.path
+        self.batchsize = p.batchsize
+        self.random_skip = p.random_skip
+        images, labels = load_shard_arrays(self.path)
+        self.images, self.labels = images, labels
+        self.sample_shape = tuple(images.shape[1:])
+        return (self.batchsize, *self.sample_shape)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        # inputs[0] is the externally-fed batch dict {"image","label"}
+        return inputs[0]
+
+
+class LMDBDataLayer(Layer):
+    """kLMDBData (reference: layer.cc:237-328) — config-compatible gate.
+
+    The reference reads Caffe LMDB databases; this environment ships no
+    lmdb binding, so the layer exists to give a precise, actionable error:
+    convert the LMDB to a shard with the loader CLI and switch the layer
+    type. The *config* still parses unchanged.
+    """
+
+    TYPE = "kLMDBData"
+    is_datalayer = True
+
+    def setup(self, src_shapes, batchsize):
+        raise ConfigError(
+            f"layer {self.name!r}: kLMDBData requires an LMDB binding that "
+            "is not available here; convert the database to a shard "
+            "(python -m singa_tpu.data.loader) and use kShardData"
+        )
+
+
+class MnistImageLayer(Layer):
+    """kMnistImage (reference: layer.cc:381-473): uint8 pixels ->
+    float (x / norm_a) - norm_b. The reference's elastic-distortion pipeline
+    is commented out there (layer.cc:410-440) and therefore not ported."""
+
+    TYPE = "kMnistImage"
+    is_parserlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.mnist_param
+        self.norm_a = p.norm_a if p else 1.0
+        self.norm_b = p.norm_b if p else 0.0
+        src = src_shapes[0]  # the data layer's (batch, H, W)
+        if len(src) < 3:
+            raise ConfigError(f"layer {self.name!r}: expects image records")
+        size = src[-1]
+        if src[-2] != size:
+            raise ConfigError(f"layer {self.name!r}: MNIST images must be square")
+        resize = p.resize if p else 0
+        if resize and resize != size:
+            raise ConfigError(
+                f"layer {self.name!r}: resize={resize} unsupported (records "
+                f"are {size}x{size}); resize at loader time instead"
+            )
+        return (src[0], size, size)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        x = inputs[0]["image"].astype(jnp.float32)
+        return x / self.norm_a - self.norm_b
+
+
+class RGBImageLayer(Layer):
+    """kRGBImage (reference: layer.cc:573-643): scale, random crop, random
+    mirror. Crop/mirror are train-time augmentations driven by the step rng;
+    eval uses a deterministic center crop like Caffe's convention."""
+
+    TYPE = "kRGBImage"
+    is_parserlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.rgbimage_param
+        self.scale = p.scale if p else 1.0
+        self.cropsize = p.cropsize if p else 0
+        self.mirror = p.mirror if p else False
+        src = src_shapes[0]
+        if len(src) != 4:
+            raise ConfigError(f"layer {self.name!r}: expects (N,C,H,W) records")
+        n, c, h, w = src
+        if self.cropsize:
+            return (n, c, self.cropsize, self.cropsize)
+        return src
+
+    def apply(self, params, inputs, *, training, rng=None):
+        import jax
+
+        x = inputs[0]["image"].astype(jnp.float32)
+        n, c, h, w = x.shape
+        if self.cropsize:
+            cs = self.cropsize
+            if training and rng is not None:
+                rh, rw = jax.random.split(rng)
+                hoff = jax.random.randint(rh, (), 0, h - cs + 1)
+                woff = jax.random.randint(rw, (), 0, w - cs + 1)
+            else:
+                hoff = (h - cs) // 2
+                woff = (w - cs) // 2
+            x = jax.lax.dynamic_slice(
+                x, (0, 0, hoff, woff), (n, c, cs, cs)
+            )
+        if self.mirror and training and rng is not None:
+            flip = jax.random.bernoulli(jax.random.fold_in(rng, 1), 0.5, (n,))
+            x = jnp.where(flip[:, None, None, None], x[..., ::-1], x)
+        if self.scale:
+            x = x * self.scale
+        return x
+
+
+class LabelLayer(Layer):
+    """kLabel (reference: layer.cc:217-233)."""
+
+    TYPE = "kLabel"
+    is_parserlayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        return (src_shapes[0][0],)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        return inputs[0]["label"].astype(jnp.int32)

@@ -1,0 +1,43 @@
+"""Loss layers (reference: SoftmaxLossLayer, src/worker/layer.cc:704-764)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import ops
+from ..config.schema import ConfigError
+from .base import Layer, Shape
+
+
+class SoftmaxLossLayer(Layer):
+    """kSoftmaxLoss: softmax + cross-entropy + top-k precision.
+
+    Takes two srclayers (logits, label). apply returns (loss, metrics); the
+    graph accumulates the loss term and the trainer averages metrics like
+    the reference's Performance class (worker.cc:350-386). Refuses
+    kLayerPartition like the reference (layer.h:216-221).
+    """
+
+    TYPE = "kSoftmaxLoss"
+    is_losslayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        if len(src_shapes) != 2:
+            raise ConfigError(
+                f"layer {self.name!r}: kSoftmaxLoss needs (logits, label) "
+                f"srclayers, got {len(src_shapes)}"
+            )
+        if self.partition_type == "kLayerPartition":
+            raise ConfigError(
+                f"layer {self.name!r}: kSoftmaxLoss cannot be layer-partitioned"
+            )
+        p = self.cfg.softmaxloss_param
+        self.topk = p.topk if p else 1
+        self.scale = p.scale if p else 1.0
+        return src_shapes[0]
+
+    def apply(self, params, inputs, *, training, rng=None):
+        logits, labels = inputs
+        return ops.softmax_loss(
+            logits, labels, topk=self.topk, scale=self.scale
+        )

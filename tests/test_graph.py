@@ -1,0 +1,236 @@
+"""Graph builder + layer tests: registry, topo sort, phase filtering,
+shape inference, and a full forward pass over a conf-built net."""
+
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import ConfigError, LayerConfig, ModelConfig
+from singa_tpu.data.loader import synthetic_arrays, write_records
+from singa_tpu.graph import build_net, topo_sort
+from singa_tpu.graph.builder import filter_phase
+from singa_tpu.layers import create_layer, registered_types
+from singa_tpu.params import init_params
+
+import jax
+
+
+REFERENCE_18 = [
+    "kConvolution", "kConcate", "kDropout", "kInnerProduct", "kRGBImage",
+    "kLabel", "kLMDBData", "kLRN", "kMnistImage", "kBridgeDst", "kBridgeSrc",
+    "kPooling", "kReLU", "kShardData", "kSlice", "kSoftmaxLoss", "kSplit",
+    "kTanh",
+]
+
+
+def test_registry_covers_reference_18():
+    # neuralnet.cc:13-33 registers exactly these
+    missing = set(REFERENCE_18) - set(registered_types())
+    assert not missing, f"missing layer types: {missing}"
+
+
+def test_unknown_type_rejected():
+    with pytest.raises(ConfigError):
+        create_layer(LayerConfig(name="x", type="kBogus"))
+
+
+def _mk(name, src=(), **kw):
+    return LayerConfig(name=name, type="kReLU", srclayers=list(src), **kw)
+
+
+def test_topo_sort_orders_dag():
+    cfgs = [_mk("c", ["a", "b"]), _mk("b", ["a"]), _mk("a")]
+    assert [c.name for c in topo_sort(cfgs)] == ["a", "b", "c"]
+
+
+def test_topo_sort_rejects_cycle_and_unknown_src():
+    with pytest.raises(ConfigError):
+        topo_sort([_mk("a", ["b"]), _mk("b", ["a"])])
+    with pytest.raises(ConfigError):
+        topo_sort([_mk("a", ["zzz"])])
+
+
+def test_phase_filtering():
+    cfg = ModelConfig.from_text(
+        """
+        neuralnet {
+          layer { name: "train_data" type: "kShardData" exclude: kTest }
+          layer { name: "test_data" type: "kShardData" exclude: kTrain }
+          layer { name: "shared" type: "kReLU" }
+        }
+        """
+    )
+    train = [l.name for l in filter_phase(cfg.neuralnet, "kTrain")]
+    test = [l.name for l in filter_phase(cfg.neuralnet, "kTest")]
+    assert train == ["train_data", "shared"]
+    assert test == ["test_data", "shared"]
+
+
+def _write_mlp_conf(tmp_path, shard, batch=8, hidden=32):
+    return ModelConfig.from_text(f"""
+        name: "t"
+        train_steps: 5
+        updater {{ type: kSGD base_learning_rate: 0.1 }}
+        neuralnet {{
+          layer {{ name: "data" type: "kShardData"
+                  data_param {{ path: "{shard}" batchsize: {batch} }} }}
+          layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+                  mnist_param {{ norm_a: 127.5 norm_b: 1 }} }}
+          layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+          layer {{ name: "fc1" type: "kInnerProduct" srclayers: "mnist"
+                  inner_product_param {{ num_output: {hidden} }}
+                  param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
+                  param {{ name: "bias" init_method: kConstant value: 0 }} }}
+          layer {{ name: "tanh1" type: "kTanh" srclayers: "fc1" }}
+          layer {{ name: "fc2" type: "kInnerProduct" srclayers: "tanh1"
+                  inner_product_param {{ num_output: 10 }}
+                  param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
+                  param {{ name: "bias" init_method: kConstant value: 0 }} }}
+          layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "fc2"
+                  srclayers: "label" softmaxloss_param {{ topk: 1 }} }}
+        }}
+    """)
+
+
+@pytest.fixture()
+def shard_dir(tmp_path):
+    folder = str(tmp_path / "shard")
+    images, labels = synthetic_arrays(64, size=12)
+    write_records(folder, images, labels)
+    return folder
+
+
+def test_build_net_shapes_and_params(shard_dir, tmp_path):
+    cfg = _write_mlp_conf(tmp_path, shard_dir, batch=8, hidden=32)
+    net = build_net(cfg, "kTrain")
+    assert [l.name for l in net.layers] == [
+        "data", "mnist", "label", "fc1", "tanh1", "fc2", "loss"]
+    assert net.name2layer["data"].out_shape == (8, 12, 12)
+    assert net.name2layer["mnist"].out_shape == (8, 12, 12)
+    assert net.name2layer["label"].out_shape == (8,)
+    assert net.name2layer["fc1"].out_shape == (8, 32)
+    assert net.name2layer["fc2"].out_shape == (8, 10)
+    specs = net.param_specs()
+    assert specs["fc1/weight"].shape == (144, 32)
+    assert specs["fc1/weight"].fan_in == 144 * 32  # reference's vdim*hdim
+    assert specs["fc2/bias"].shape == (10,)
+
+
+def test_forward_pass_loss_and_metrics(shard_dir, tmp_path):
+    cfg = _write_mlp_conf(tmp_path, shard_dir)
+    net = build_net(cfg, "kTrain")
+    params = init_params(jax.random.PRNGKey(0), net.param_specs())
+    data = net.name2layer["data"]
+    batch = {"data": {"image": data.images[:8], "label": data.labels[:8]}}
+    loss, metrics = net.forward(params, batch, training=True,
+                                rng=jax.random.PRNGKey(1))
+    # untrained 10-class net: loss near ln(10)
+    assert 1.5 < float(loss) < 3.5
+    assert 0.0 <= float(metrics["loss"]["precision"]) <= 1.0
+
+
+def test_conv_net_shape_inference(shard_dir, tmp_path):
+    cfg = ModelConfig.from_text(f"""
+        neuralnet {{
+          layer {{ name: "data" type: "kShardData"
+                  data_param {{ path: "{shard_dir}" batchsize: 4 }} }}
+          layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+                  mnist_param {{ norm_a: 255 norm_b: 0 }} }}
+          layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+          layer {{ name: "conv1" type: "kConvolution" srclayers: "mnist"
+                  convolution_param {{ num_filters: 6 kernel: 5 }}
+                  param {{ name: "weight" init_method: kGaussain std: 0.1 }}
+                  param {{ name: "bias" init_method: kConstant value: 0 }} }}
+          layer {{ name: "pool1" type: "kPooling" srclayers: "conv1"
+                  pooling_param {{ pool: MAX kernel: 2 stride: 2 }} }}
+          layer {{ name: "relu1" type: "kReLU" srclayers: "pool1" }}
+          layer {{ name: "norm1" type: "kLRN" srclayers: "relu1"
+                  lrn_param {{ local_size: 3 alpha: 0.00005 beta: 0.75 }} }}
+          layer {{ name: "drop" type: "kDropout" srclayers: "norm1"
+                  dropout_param {{ dropout_ratio: 0.3 }} }}
+          layer {{ name: "ip" type: "kInnerProduct" srclayers: "drop"
+                  inner_product_param {{ num_output: 10 }}
+                  param {{ name: "weight" init_method: kGaussain std: 0.1 }}
+                  param {{ name: "bias" init_method: kConstant value: 0 }} }}
+          layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "ip"
+                  srclayers: "label" }}
+        }}
+    """)
+    net = build_net(cfg, "kTrain")
+    # 12x12 -> conv5 -> 8x8 -> pool2/2 -> 4x4
+    assert net.name2layer["conv1"].out_shape == (4, 6, 8, 8)
+    assert net.name2layer["pool1"].out_shape == (4, 6, 4, 4)
+    assert net.param_specs()["conv1/weight"].shape == (6, 25)
+    assert net.param_specs()["conv1/weight"].fan_in == 25  # col_height
+
+    params = init_params(jax.random.PRNGKey(0), net.param_specs())
+    data = net.name2layer["data"]
+    batch = {"data": {"image": data.images[:4], "label": data.labels[:4]}}
+    loss, _ = net.forward(params, batch, training=True,
+                          rng=jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
+    # eval path: dropout off, no rng needed
+    loss2, _ = net.forward(params, batch, training=False)
+    assert np.isfinite(float(loss2))
+
+
+def test_slice_concate_split_dataflow(shard_dir, tmp_path):
+    cfg = ModelConfig.from_text(f"""
+        neuralnet {{
+          layer {{ name: "data" type: "kShardData"
+                  data_param {{ path: "{shard_dir}" batchsize: 4 }} }}
+          layer {{ name: "mnist" type: "kMnistImage" srclayers: "data" }}
+          layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+          layer {{ name: "fc" type: "kInnerProduct" srclayers: "mnist"
+                  inner_product_param {{ num_output: 16 }} }}
+          layer {{ name: "slice" type: "kSlice" srclayers: "fc"
+                  slice_param {{ slice_dimension: 1 slice_num: 2 }} }}
+          layer {{ name: "a" type: "kReLU" srclayers: "slice" }}
+          layer {{ name: "b" type: "kTanh" srclayers: "slice" }}
+          layer {{ name: "cat" type: "kConcate" srclayers: "a" srclayers: "b"
+                  concate_param {{ concate_dimension: 1 concate_num: 2 }} }}
+          layer {{ name: "out" type: "kInnerProduct" srclayers: "cat"
+                  inner_product_param {{ num_output: 10 }} }}
+          layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "out"
+                  srclayers: "label" }}
+        }}
+    """)
+    net = build_net(cfg, "kTrain")
+    assert net.name2layer["slice"].out_shape == (4, 8)
+    assert net.name2layer["cat"].out_shape == (4, 16)
+    params = init_params(jax.random.PRNGKey(0), net.param_specs())
+    data = net.name2layer["data"]
+    batch = {"data": {"image": data.images[:4], "label": data.labels[:4]}}
+    loss, _ = net.forward(params, batch, training=False)
+    assert np.isfinite(float(loss))
+
+
+def test_lmdb_layer_gated(tmp_path):
+    cfg = ModelConfig.from_text("""
+        neuralnet {
+          layer { name: "data" type: "kLMDBData"
+                  data_param { path: "/nope" batchsize: 4 } }
+        }
+    """)
+    with pytest.raises(ConfigError, match="kShardData"):
+        build_net(cfg, "kTrain")
+
+
+def test_duplicate_names_after_filter_rejected(shard_dir):
+    cfg = ModelConfig.from_text(f"""
+        neuralnet {{
+          layer {{ name: "data" type: "kShardData"
+                  data_param {{ path: "{shard_dir}" batchsize: 4 }} }}
+          layer {{ name: "data" type: "kShardData"
+                  data_param {{ path: "{shard_dir}" batchsize: 4 }} }}
+        }}
+    """)
+    with pytest.raises(ConfigError, match="duplicate"):
+        build_net(cfg, "kTrain")
+
+
+def test_net_to_json(shard_dir, tmp_path):
+    cfg = _write_mlp_conf(tmp_path, shard_dir)
+    net = build_net(cfg, "kTrain")
+    j = net.to_json()
+    assert {n["id"] for n in j["nodes"]} == set(net.name2layer)
+    assert {"source": "fc1", "target": "tanh1"} in j["links"]
