@@ -1,0 +1,72 @@
+"""Benchmark: MNIST MLP training throughput on the real chip.
+
+Workload = the reference's headline job (examples/mnist/mlp.conf: six FC
+layers 2500-2000-1500-1000-500-10, batch 1000, SGD) — the same model the
+reference's batch.sh scaling sweep measures (examples/mnist/batch.sh:3-17).
+Data is synthetic MNIST-shaped records through the real shard pipeline, so
+the number includes host batch assembly + transfer, like the reference's
+per-step TimerInfo totals include its prefetch thread.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against BASELINE_SPS below — the round-2 real-TPU
+measurement recorded in BASELINE.md (the reference repo publishes no
+numbers, BASELINE.md:3-8, so our first TPU run is the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# First real-chip measurement (round 2, TPU v5 lite, fp32 path, prefetch
+# pipeline): 55096 samples/sec. Later measurements compare against this.
+BASELINE_SPS = 55_096.0
+
+WARMUP_STEPS = 5
+MEASURE_STEPS = 50
+
+
+def main() -> int:
+    import jax
+
+    from __graft_entry__ import _flagship_cfg
+    from singa_tpu.trainer import Trainer
+
+    cfg = _flagship_cfg(batchsize=1000)
+    cfg.train_steps = WARMUP_STEPS + MEASURE_STEPS
+    cfg.test_steps = 0
+    cfg.display_frequency = 0
+    trainer = Trainer(cfg, seed=0, log=lambda s: None, prefetch=True)
+
+    for step in range(WARMUP_STEPS):
+        trainer.train_one_batch(step)
+    jax.block_until_ready(trainer.params)
+
+    t0 = time.perf_counter()
+    for step in range(WARMUP_STEPS, WARMUP_STEPS + MEASURE_STEPS):
+        trainer.train_one_batch(step)
+    jax.block_until_ready(trainer.params)
+    dt = time.perf_counter() - t0
+
+    sps = MEASURE_STEPS * trainer.train_net.batchsize / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_train_throughput",
+                "value": round(sps, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(sps / BASELINE_SPS, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,8 @@ from .schema import (
     UpdaterConfig,
     load_cluster_config,
     load_model_config,
+    parse_cluster_config,
+    parse_model_config,
 )
 from .textproto import TextProtoError, parse, parse_file
 
@@ -29,6 +31,8 @@ __all__ = [
     "TextProtoError",
     "load_cluster_config",
     "load_model_config",
+    "parse_cluster_config",
+    "parse_model_config",
     "parse",
     "parse_file",
 ]

@@ -469,9 +469,12 @@ class ModelConfig(Message):
         "alg": Field("enum", "kBackPropagation", enum=GRAD_CALC_ALGS),
         "neuralnet": Field("message", message=NetConfig),
         "debug": Field("bool", False),
-        # --- singa-tpu extension: checkpoint restore path (fills the
-        # reference's unimplemented Worker::Resume, worker.cc:65-67) ---
+        # --- singa-tpu extensions: checkpoint restore path + save cadence
+        # (fills the reference's unimplemented Worker::Resume,
+        # worker.cc:65-67; the reference has no snapshot cadence at all) ---
         "checkpoint": Field("string"),
+        "checkpoint_frequency": Field("int", 0),
+        "checkpoint_after_steps": Field("int", 0),
     }
 
 
@@ -517,3 +520,11 @@ def load_model_config(path: str) -> ModelConfig:
 
 def load_cluster_config(path: str) -> ClusterConfig:
     return ClusterConfig.from_file(path)
+
+
+def parse_model_config(text: str) -> ModelConfig:
+    return ModelConfig.from_text(text)
+
+
+def parse_cluster_config(text: str) -> ClusterConfig:
+    return ClusterConfig.from_text(text)

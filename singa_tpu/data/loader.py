@@ -93,13 +93,23 @@ def digits_arrays(split: str = "train") -> tuple[np.ndarray, np.ndarray]:
 
 
 def synthetic_arrays(
-    n: int, classes: int = 10, size: int = 28, seed: int = 0
+    n: int,
+    classes: int = 10,
+    size: int = 28,
+    seed: int = 0,
+    noise_seed: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Gaussian class-template blobs: learnable, deterministic, no IO."""
+    """Gaussian class-template blobs: learnable, deterministic, no IO.
+
+    ``seed`` fixes the class templates, ``noise_seed`` the per-sample noise —
+    pass different noise seeds to get disjoint train/test splits of the same
+    classification problem.
+    """
     rng = np.random.RandomState(seed)
     templates = rng.rand(classes, size, size) * 160.0
     labels = (np.arange(n) % classes).astype(np.uint8)
-    noise = rng.rand(n, size, size) * 95.0
+    nrng = rng if noise_seed is None else np.random.RandomState(noise_seed)
+    noise = nrng.rand(n, size, size) * 95.0
     images = (templates[labels] + noise).clip(0, 255).astype(np.uint8)
     return images, labels
 

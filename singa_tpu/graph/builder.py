@@ -116,12 +116,15 @@ class Net:
         *,
         training: bool,
         rng: jax.Array | None = None,
-    ) -> tuple[jnp.ndarray, dict[str, dict[str, jnp.ndarray]]]:
+        return_acts: bool = False,
+    ):
         """Run all layers; returns (total_loss, {losslayer: metrics}).
 
         ``batch`` maps each data layer's name to its input dict
         ({"image": ..., "label": ...}); shared params resolve through their
-        owner's array (ParamSpec.owner).
+        owner's array (ParamSpec.owner). With ``return_acts`` the per-layer
+        activation dict is appended — the debug-mode hook (the reference
+        dumps per-layer L1 norms, neuralnet.cc:350-378).
         """
         resolved = dict(params)
         for layer in self.layers:
@@ -154,6 +157,8 @@ class Net:
                 acts[layer.name] = loss
             else:
                 acts[layer.name] = out
+        if return_acts:
+            return total_loss, metrics, acts
         return total_loss, metrics
 
     # ---------------- observability ----------------

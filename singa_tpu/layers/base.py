@@ -57,7 +57,12 @@ class Layer:
         return self._param_specs
 
     def _declare_param(
-        self, idx: int, default_name: str, shape: Shape, fan_in: int = 0
+        self,
+        idx: int,
+        default_name: str,
+        shape: Shape,
+        fan_in: int = 0,
+        neuron_axis: int | None = None,
     ) -> str:
         """Register param ``<layer>/<name>`` from cfg.param[idx] (if given)."""
         cfg = self.cfg.param[idx] if idx < len(self.cfg.param) else None
@@ -66,7 +71,12 @@ class Layer:
         share = list(self.cfg.share_param)
         owner = share[idx] if idx < len(share) else None
         self._param_specs[qualified] = ParamSpec.from_config(
-            cfg, qualified, tuple(shape), fan_in=fan_in, owner=owner
+            cfg,
+            qualified,
+            tuple(shape),
+            fan_in=fan_in,
+            owner=owner,
+            neuron_axis=neuron_axis,
         )
         return qualified
 

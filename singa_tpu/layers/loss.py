@@ -27,10 +27,15 @@ class SoftmaxLossLayer(Layer):
                 f"layer {self.name!r}: kSoftmaxLoss needs (logits, label) "
                 f"srclayers, got {len(src_shapes)}"
             )
-        if self.partition_type == "kLayerPartition":
+        if self.cfg.partition_type == "kLayerPartition":
             raise ConfigError(
                 f"layer {self.name!r}: kSoftmaxLoss cannot be layer-partitioned"
             )
+        if self.partition_type == "kLayerPartition":
+            # net-level kLayerPartition downgrades to kNone here, like the
+            # reference forcing the loss layer out of the neuron split
+            # (layer.h:216-221)
+            self.partition_type = "kNone"
         p = self.cfg.softmaxloss_param
         self.topk = p.topk if p else 1
         self.scale = p.scale if p else 1.0

@@ -42,11 +42,17 @@ class ConvolutionLayer(Layer):
         conv_w = (width + 2 * self.pad - self.kernel) // self.stride + 1
         col_height = channels * self.kernel * self.kernel
         self.wname = self._declare_param(
-            0, "weight", (self.num_filters, col_height), fan_in=col_height
+            0,
+            "weight",
+            (self.num_filters, col_height),
+            fan_in=col_height,
+            neuron_axis=0,  # kLayerPartition splits num_filters (layer.cc:54-61)
         )
         self.bias_term = p.bias_term
         if self.bias_term:
-            self.bname = self._declare_param(1, "bias", (self.num_filters,))
+            self.bname = self._declare_param(
+                1, "bias", (self.num_filters,), neuron_axis=0
+            )
         return (src[0], self.num_filters, conv_h, conv_w)
 
     def apply(self, params, inputs, *, training, rng=None):
@@ -79,11 +85,17 @@ class InnerProductLayer(Layer):
             vdim *= d
         self.vdim, self.hdim = vdim, p.num_output
         self.wname = self._declare_param(
-            0, "weight", (vdim, self.hdim), fan_in=vdim * self.hdim
+            0,
+            "weight",
+            (vdim, self.hdim),
+            fan_in=vdim * self.hdim,
+            neuron_axis=1,  # kLayerPartition splits num_output (layer.cc:177-184)
         )
         self.bias_term = p.bias_term
         if self.bias_term:
-            self.bname = self._declare_param(1, "bias", (self.hdim,))
+            self.bname = self._declare_param(
+                1, "bias", (self.hdim,), neuron_axis=0
+            )
         return (src[0], self.hdim)
 
     def apply(self, params, inputs, *, training, rng=None):

@@ -1,0 +1,51 @@
+"""CLI entry point: ``python -m singa_tpu.main -model_conf F -cluster_conf F``.
+
+Mirrors the reference binary's gflags surface (src/main.cc:13-18:
+-procsID, -hostfile, -cluster_conf, -model_conf) so reference job launch
+lines work unchanged. The worker/server role dispatch (main.cc:49-55)
+disappears: there is no parameter-server tier — every process is a trainer
+and grad sync is an XLA collective. -procsID/-hostfile are accepted and
+ignored for that reason (multi-host initialization is
+jax.distributed.initialize's job, driven by the TPU runtime's own
+environment, not a hostfile).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import load_cluster_config, load_model_config
+from .trainer import Trainer
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="singa_tpu", description=__doc__, add_help=True
+    )
+    ap.add_argument("-model_conf", required=True, help="ModelProto text file")
+    ap.add_argument("-cluster_conf", default=None, help="ClusterProto text file")
+    ap.add_argument("-procsID", type=int, default=0, help="accepted; unused")
+    ap.add_argument("-hostfile", default=None, help="accepted; unused")
+    ap.add_argument("-seed", type=int, default=0, help="init/dropout RNG seed")
+    return ap.parse_args(argv)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = parse_args(argv)
+    model_cfg = load_model_config(args.model_conf)
+    cluster_cfg = (
+        load_cluster_config(args.cluster_conf) if args.cluster_conf else None
+    )
+    trainer = Trainer(model_cfg, cluster_cfg, seed=args.seed)
+    trainer.log(
+        f"training {model_cfg.name!r}: steps "
+        f"[{trainer.start_step}, {model_cfg.train_steps}), "
+        f"batch {trainer.train_net.batchsize}, mesh {dict(trainer.mesh.shape)}"
+    )
+    trainer.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Distribution layer: device meshes + GSPMD shardings.
+
+Replaces the reference's entire distribution stack — the Cluster topology
+singleton (include/utils/cluster.h), the ZeroMQ parameter-server protocol
+(src/server/server.cc, src/worker/param_manager.cc), the graph-rewriting
+partitioner (src/worker/neuralnet.cc:112-323), and the PUSH/PULL activation
+bridges (src/worker/worker.cc:139-155) — with a `jax.sharding.Mesh` plus
+sharding annotations. XLA's GSPMD pass inserts the collectives (psum for
+grad sync over ICI, all-gather/reduce-scatter for layer partitions) that the
+reference implemented by hand over TCP.
+"""
+
+from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, mesh_from_cluster
+from .shardings import (
+    batch_shardings,
+    param_shardings,
+    replicated,
+    state_shardings,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "build_mesh",
+    "mesh_from_cluster",
+    "batch_shardings",
+    "param_shardings",
+    "replicated",
+    "state_shardings",
+]

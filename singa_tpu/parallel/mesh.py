@@ -1,0 +1,65 @@
+"""ClusterConfig -> jax.sharding.Mesh.
+
+The reference partitions its processes into worker *groups*: each group
+holds one full model replica (data parallelism across groups) and
+``nprocs_per_group`` processes that may split the model inside the group
+(include/utils/cluster.h:42-60). The TPU-native mapping is a 2-D device
+mesh:
+
+    data axis  = ngroups            (one replica per mesh row)
+    model axis = nprocs_per_group   (kLayerPartition splits ride this axis)
+
+Servers (`nservers`) have no mesh footprint: the parameter-server tier
+dissolves into GSPMD grad psum over the data axis. ``nthreads_per_procs``
+(intra-process hogwild replicas) likewise dissolves — a single XLA program
+already saturates a chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config.schema import ClusterConfig, ConfigError
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(
+    ndata: int = 1, nmodel: int = 1, devices=None
+) -> Mesh:
+    """Build a (data, model) mesh over the first ndata*nmodel devices.
+
+    Axis order is (data, model) so that model-partition collectives ride
+    the innermost (fastest, ICI-nearest) device ring, matching how the
+    reference keeps intra-group bridges on the LAN while PS sync crosses
+    racks.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    need = ndata * nmodel
+    if need > len(devices):
+        raise ConfigError(
+            f"mesh wants {ndata}x{nmodel}={need} devices, "
+            f"only {len(devices)} visible"
+        )
+    grid = np.array(devices[:need]).reshape(ndata, nmodel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def mesh_from_cluster(
+    cluster: ClusterConfig | None, devices=None
+) -> Mesh:
+    """Map the reference cluster topology onto a device mesh.
+
+    ngroups -> data axis, nprocs_per_group -> model axis
+    (include/utils/cluster.h:49-60). With no cluster config, every visible
+    device joins the data axis — the common pure-DP case.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if cluster is None or not cluster.nworkers:
+        return build_mesh(len(devices), 1, devices)
+    nmodel = max(1, cluster.nprocs_per_group)
+    ndata = cluster.ngroups
+    return build_mesh(ndata, nmodel, devices)

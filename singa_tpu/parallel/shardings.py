@@ -1,0 +1,77 @@
+"""Partition semantics -> GSPMD sharding annotations.
+
+The reference's partitioner rewrites the layer graph: kDataPartition splits
+every blob's batch dim 0, kLayerPartition splits the neuron dim 1, and
+Slice/Concate/Split/Bridge connectors plus ZeroMQ shuffles move the pieces
+(src/worker/neuralnet.cc:198-323, partition_dimension at
+base_layer.h:121-128). Here the graph is left untouched; the same semantics
+are expressed as shardings on the jitted step's inputs:
+
+  kDataPartition  -> batch arrays sharded over the data axis; params
+                     replicated; XLA psums grads (= ParamSync, replacing
+                     param_manager.cc:160-231).
+  kLayerPartition -> each param sharded over the model axis along its
+                     declared ``neuron_axis``; XLA's propagation pass then
+                     shards the matching activations and inserts exactly the
+                     slice/concat/shuffle collectives the reference built by
+                     hand ("the most complex scenario", neuralnet.cc:265-280).
+
+The reference gives the last partition any remainder (neuralnet.cc:160-162);
+XLA shards evenly, so an indivisible neuron dim falls back to replication
+for that param (documented divergence, SURVEY hard-part #3).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graph.builder import Net
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_shardings(mesh: Mesh, net: Net) -> dict:
+    """Sharding pytree for the step's batch input: every array in every
+    data layer's feed dict is sharded on dim 0 over the data axis."""
+    leaf = NamedSharding(mesh, P(DATA_AXIS))
+    return {
+        layer.name: {"image": leaf, "label": leaf}
+        for layer in net.datalayers
+    }
+
+
+def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
+    """Per-param shardings implementing the layer's partition_type.
+
+    Only layers whose partition_dim is 1 (kLayerPartition) shard their
+    params, along each param's neuron_axis; everything else replicates
+    (data-parallel grads sync via psum, which GSPMD inserts because the
+    loss is a mean over the sharded batch dim).
+    """
+    nmodel = mesh.shape[MODEL_AXIS]
+    out: dict[str, NamedSharding] = {}
+    for layer in net.layers:
+        for name, spec in layer.param_specs().items():
+            sharding = replicated(mesh)
+            if (
+                layer.partition_dim == 1
+                and spec.neuron_axis is not None
+                and nmodel > 1
+                and spec.shape[spec.neuron_axis] % nmodel == 0
+            ):
+                axes: list = [None] * len(spec.shape)
+                axes[spec.neuron_axis] = MODEL_AXIS
+                sharding = NamedSharding(mesh, P(*axes))
+            out[name] = sharding
+    return out
+
+
+def state_shardings(
+    param_sh: dict[str, NamedSharding], slots: tuple[str, ...]
+) -> dict[str, dict[str, NamedSharding]]:
+    """Updater slots (history/update) mirror their param's sharding, like
+    the reference keeps history blobs beside data blobs (param.h:136)."""
+    return {name: {s: sh for s in slots} for name, sh in param_sh.items()}

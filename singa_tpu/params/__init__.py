@@ -40,6 +40,11 @@ class ParamSpec:
     wd_mult: float = 1.0
     fan_in: int = 0
     owner: str | None = None  # share_param: alias of another param's storage
+    # Which array axis holds the layer's neuron dimension — the axis
+    # kLayerPartition splits (reference: base_layer.h:121-128 picks dim 1 of
+    # the *blob*; per-param this is dim 1 for FC weights, dim 0 for conv
+    # filters/biases). None = never model-sharded.
+    neuron_axis: int | None = None
 
     @classmethod
     def from_config(
@@ -49,9 +54,16 @@ class ParamSpec:
         shape: tuple[int, ...],
         fan_in: int = 0,
         owner: str | None = None,
+        neuron_axis: int | None = None,
     ) -> "ParamSpec":
         if cfg is None:
-            return cls(name=name, shape=shape, fan_in=fan_in, owner=owner)
+            return cls(
+                name=name,
+                shape=shape,
+                fan_in=fan_in,
+                owner=owner,
+                neuron_axis=neuron_axis,
+            )
         return cls(
             name=name,
             shape=shape,
@@ -65,6 +77,7 @@ class ParamSpec:
             wd_mult=cfg.weight_decay_multiplier,
             fan_in=fan_in,
             owner=owner,
+            neuron_axis=neuron_axis,
         )
 
 

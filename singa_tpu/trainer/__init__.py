@@ -1,0 +1,6 @@
+"""Training engine (the reference's worker side, L5)."""
+
+from .checkpoint import load_checkpoint, restore_into, save_checkpoint
+from .trainer import Trainer
+
+__all__ = ["Trainer", "save_checkpoint", "load_checkpoint", "restore_into"]

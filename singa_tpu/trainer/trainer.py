@@ -1,0 +1,308 @@
+"""The training engine.
+
+Replaces the reference's worker-side stack — Worker::Start/Run/RunOneBatch
+(src/worker/worker.cc:14-106,187-213), Executor::TrainOneBatch (:304-316),
+and ParamManager's init/update machinery (src/worker/param_manager.cc) —
+with one `jit`-compiled, sharded XLA train step driven by a plain Python
+cadence loop. The Forward/Backward hot loops (worker.cc:240-302), the
+per-param WaitUpdate blocking, the bridge spins, and the PS sync sends all
+dissolve into that single program; gradient sync across the data-parallel
+mesh axis is the psum GSPMD inserts because the loss is a mean over the
+sharded batch dim.
+
+Cadence semantics match the reference's predicates exactly
+(include/worker/worker.h:118-158): XNow(step) = freq > 0 and
+step >= after and (step - after) % freq == 0; tests/validation run *before*
+the train step of the step they trigger on (worker.cc:190-200).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ClusterConfig, ConfigError, ModelConfig
+from ..data.pipeline import BatchPipeline
+from ..graph.builder import Net, build_net
+from ..optim import make_updater
+from ..parallel import (
+    batch_shardings,
+    mesh_from_cluster,
+    param_shardings,
+    replicated,
+    state_shardings,
+)
+from ..params import init_params
+from ..utils import Performance, Timers, dump_net_json
+from .checkpoint import restore_into, save_checkpoint
+
+
+def _now(step: int, freq: int, after: int) -> bool:
+    """The reference's {Display,Test,Validate}Now predicate (worker.h:118-158)."""
+    return freq > 0 and step >= after and (step - after) % freq == 0
+
+
+class Trainer:
+    """Builds nets, owns params/updater state, runs the cadence loop."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        cluster_cfg: ClusterConfig | None = None,
+        *,
+        mesh=None,
+        seed: int = 0,
+        log: Callable[[str], None] = print,
+        prefetch: bool | None = None,
+    ):
+        self.cfg = model_cfg
+        self.cluster = cluster_cfg
+        self.log = log
+        self.perf = Performance()
+        self.timers = Timers()
+
+        # --- nets (SetupNeuralNet x3, phase-filtered; worker.cc:16-27) ---
+        self.train_net = build_net(model_cfg, "kTrain")
+        self.test_net: Net | None = None
+        self.val_net: Net | None = None
+        # built whenever steps are configured (like worker.cc:16-27 — the
+        # cadence only gates *running* them)
+        if model_cfg.test_steps:
+            self.test_net = build_net(model_cfg, "kTest")
+        if model_cfg.validation_steps:
+            self.val_net = build_net(model_cfg, "kValidation")
+
+        # --- params + updater (ParamManager ctor + InitParams) ---
+        self.specs = self.train_net.param_specs()
+        if model_cfg.updater is None:
+            raise ConfigError("model config has no updater block")
+        self.updater = make_updater(model_cfg.updater)
+        root = jax.random.PRNGKey(seed)
+        self._init_key, self._step_key = jax.random.split(root)
+        params = init_params(self._init_key, self.specs)
+        state = self.updater.init_state(params)
+
+        # --- resume (fills Worker::Resume, worker.cc:65-67) ---
+        self.start_step = model_cfg.step
+        if model_cfg.checkpoint:
+            ck_step, params, state = restore_into(
+                model_cfg.checkpoint, params, state
+            )
+            self.start_step = max(self.start_step, ck_step)
+            self.log(
+                f"resumed from {model_cfg.checkpoint} at step {self.start_step}"
+            )
+
+        # --- mesh + shardings (replaces Cluster/PS/partitioner) ---
+        self.mesh = mesh if mesh is not None else mesh_from_cluster(cluster_cfg)
+        self.param_sh = param_shardings(self.mesh, self.train_net)
+        self.state_sh = state_shardings(self.param_sh, self.updater.SLOTS)
+        self.batch_sh = batch_shardings(self.mesh, self.train_net)
+        self._repl = replicated(self.mesh)
+        self.params = {
+            n: jax.device_put(v, self.param_sh[n]) for n, v in params.items()
+        }
+        self.state = {
+            n: {
+                s: jax.device_put(v, self.state_sh[n][s])
+                for s, v in slots.items()
+            }
+            for n, slots in state.items()
+        }
+
+        # --- input pipelines (prefetch thread; base_layer.h:510-537) ---
+        if prefetch is None:
+            prefetch = model_cfg.prefetch
+        self._pipelines: dict[int, dict[str, BatchPipeline]] = {}
+        for net in (self.train_net, self.test_net, self.val_net):
+            if net is None:
+                continue
+            self._pipelines[id(net)] = {
+                l.name: BatchPipeline(
+                    l.images,
+                    l.labels,
+                    l.batchsize,
+                    random_skip=l.random_skip if net is self.train_net else 0,
+                    prefetch=prefetch and net is self.train_net,
+                    seed=seed,
+                )
+                for l in net.datalayers
+            }
+
+        # --- the one compiled program ---
+        self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
+        self._eval_steps: dict[int, Callable] = {}
+        self._batch_size = self.train_net.batchsize
+
+    # ------------------------------------------------------------------
+    # compiled step functions
+    # ------------------------------------------------------------------
+
+    def _train_step_fn(self, params, state, step, batch, rng):
+        def loss_fn(p):
+            loss, metrics = self.train_net.forward(
+                p, batch, training=True, rng=rng
+            )
+            return loss, metrics
+
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, state = self.updater.apply(
+            step, params, grads, state, self.specs
+        )
+        return params, state, metrics
+
+    def _eval_step_for(self, net: Net) -> Callable:
+        if id(net) not in self._eval_steps:
+
+            def eval_fn(params, batch):
+                _, metrics = net.forward(params, batch, training=False)
+                return metrics
+
+            self._eval_steps[id(net)] = jax.jit(eval_fn)
+        return self._eval_steps[id(net)]
+
+    # ------------------------------------------------------------------
+    # host-side loop
+    # ------------------------------------------------------------------
+
+    def _next_batch(self, net: Net) -> dict:
+        """Assemble + shard one batch dict for ``net``'s data layers."""
+        out = {}
+        for name, pipe in self._pipelines[id(net)].items():
+            images, labels = pipe.next_batch()
+            sh = self.batch_sh.get(name)
+            leaf_i = sh["image"] if sh and net is self.train_net else self._repl
+            leaf_l = sh["label"] if sh and net is self.train_net else self._repl
+            out[name] = {
+                "image": jax.device_put(images, leaf_i),
+                "label": jax.device_put(labels, leaf_l),
+            }
+        return out
+
+    def train_one_batch(self, step: int) -> None:
+        """TrainOneBatch (worker.cc:304-316): one forward+backward+update."""
+        with self.timers.phase("data"):
+            batch = self._next_batch(self.train_net)
+        self._last_batch = batch  # debug dumps reuse it (no stream skew)
+        rng = jax.random.fold_in(self._step_key, step)
+        with self.timers.phase("train"):
+            self.params, self.state, metrics = self._train_step(
+                self.params,
+                self.state,
+                jnp.int32(step),
+                batch,
+                rng,
+            )
+        self.perf.update(metrics)
+
+    def evaluate(self, net: Net, nsteps: int, phase: str, step: int) -> dict:
+        """Test/Validate (worker.cc:318-348): nsteps batches, averaged."""
+        fn = self._eval_step_for(net)
+        perf = Performance()
+        with self.timers.phase("eval"):
+            for _ in range(nsteps):
+                perf.update(fn(self.params, self._next_batch(net)))
+        avg = perf.avg()
+        self.log(f"step {step}: {phase} {perf.to_string()}")
+        return avg
+
+    def run_one_batch(self, step: int) -> None:
+        """RunOneBatch (worker.cc:187-213): cadences around the train step."""
+        cfg = self.cfg
+        if self.val_net is not None and _now(
+            step, cfg.validation_frequency, cfg.validation_after_steps
+        ):
+            self.evaluate(
+                self.val_net, cfg.validation_steps, "validation", step
+            )
+        if self.test_net is not None and _now(
+            step, cfg.test_frequency, cfg.test_after_steps
+        ):
+            self.evaluate(self.test_net, cfg.test_steps, "test", step)
+        self.train_one_batch(step)
+        if _now(step, cfg.display_frequency, cfg.display_after_steps):
+            sps = 0.0
+            t = self.timers.total("train") + self.timers.total("data")
+            if t > 0:
+                sps = self.perf.count * self._batch_size / t
+            self.log(
+                f"step {step}: train {self.perf.to_string()} "
+                f"[{self.timers.to_string()}; {sps:.0f} samples/s]"
+            )
+            if cfg.debug:
+                self.log(self.debug_string(step))
+            self.perf.reset()
+            self.timers.reset()
+        # snapshot labels carry the RESUME step (steps completed), matching
+        # the end-of-run save and restore_into's start_step contract — so a
+        # resumed run never replays the step it saved after
+        done = step + 1
+        if (
+            _now(done, cfg.checkpoint_frequency, cfg.checkpoint_after_steps)
+            and done > self.start_step
+            and done < cfg.train_steps  # run() writes the final snapshot
+        ):
+            self.save(done)
+
+    def run(self) -> None:
+        """Worker::Run (worker.cc:98-106): the full training loop."""
+        if self.cluster is not None and self.cluster.workspace:
+            vis = os.path.join(
+                self.cluster.workspace, self.cluster.vis_subfolder
+            )
+            for net in (self.train_net, self.test_net, self.val_net):
+                if net is not None:
+                    dump_net_json(net, vis)
+        for step in range(self.start_step, self.cfg.train_steps):
+            self.run_one_batch(step)
+        if self._checkpoint_dir() is not None:
+            self.save(self.cfg.train_steps)
+
+    # ------------------------------------------------------------------
+    # checkpoint + debug
+    # ------------------------------------------------------------------
+
+    def _checkpoint_dir(self) -> str | None:
+        if self.cluster is not None and self.cluster.workspace:
+            return os.path.join(self.cluster.workspace, "checkpoints")
+        return None
+
+    def save(self, step: int) -> str | None:
+        folder = self._checkpoint_dir()
+        if folder is None:
+            return None
+        path = os.path.join(folder, f"step_{step}.npz")
+        save_checkpoint(path, step, self.params, self.state)
+        self.log(f"step {step}: checkpoint -> {path}")
+        return path
+
+    def debug_string(self, step: int) -> str:
+        """Per-layer mean-|activation| + per-param mean-|value| lines, the
+        reference's debug dump (worker.cc:262-265, neuralnet.cc:350-378).
+        Reuses the step's own batch — debug mode must not consume extra
+        training data or shift the stream position."""
+        batch = self._last_batch
+        rng = jax.random.fold_in(self._step_key, step)
+        _, _, acts = self.train_net.forward(
+            self.params, batch, training=True, rng=rng, return_acts=True
+        )
+        lines = [
+            "debug: "
+            + ", ".join(
+                f"{name} {float(jnp.mean(jnp.abs(a))):.4g}"
+                for name, a in acts.items()
+                if hasattr(a, "dtype")
+            )
+        ]
+        lines.append(
+            "params: "
+            + ", ".join(
+                f"{n} {float(jnp.mean(jnp.abs(v))):.4g}"
+                for n, v in sorted(self.params.items())
+            )
+        )
+        return "\n".join(lines)

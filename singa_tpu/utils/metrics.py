@@ -1,0 +1,52 @@
+"""Metric averaging (the reference's Performance class).
+
+Worker::Performance accumulates each loss layer's metric blob every step
+and prints the element-wise average every display interval, then resets
+(src/worker/worker.cc:350-386). Metrics arrive here as jnp scalars; they
+are kept on device and only pulled to host at Avg() time so accumulation
+never blocks the async dispatch queue.
+"""
+
+from __future__ import annotations
+
+
+class Performance:
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._sums: dict[str, dict[str, object]] = {}
+        self._count = 0
+
+    def update(self, metrics: dict[str, dict]) -> None:
+        """Accumulate one step's {losslayer: {metric: scalar}}.
+
+        Sums are folded into one running device scalar per metric (a lazy
+        device-side add) so memory stays constant over arbitrarily long
+        display intervals and no step ever blocks on a host sync.
+        """
+        self._count += 1
+        for lname, m in metrics.items():
+            bucket = self._sums.setdefault(lname, {})
+            for k, v in m.items():
+                bucket[k] = v if k not in bucket else bucket[k] + v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def avg(self) -> dict[str, dict[str, float]]:
+        """Element-wise averages since the last reset (worker.cc:367-376)."""
+        n = max(self._count, 1)
+        return {
+            lname: {k: float(total) / n for k, total in bucket.items()}
+            for lname, bucket in self._sums.items()
+        }
+
+    def to_string(self) -> str:
+        """One-line display like Worker's "loss : 2.301, precision : 0.11"."""
+        parts = []
+        for lname, bucket in sorted(self.avg().items()):
+            inner = ", ".join(f"{k} : {v:.6g}" for k, v in sorted(bucket.items()))
+            parts.append(f"{lname} [{inner}]" if len(self._sums) > 1 else inner)
+        return ", ".join(parts) if parts else "no metrics"

@@ -1,0 +1,51 @@
+"""Per-phase wall-clock timers (TimerInfo parity).
+
+The reference's Executor keeps millisecond accumulators per phase —
+tForward_/tBackward_/tSyncData_/tSyncParam_ — and prints them with the
+metrics each display interval (include/worker/worker.h:91-114). One jitted
+XLA program fuses forward/backward/update, so the TPU-native phases are:
+
+  train  — device step time (dispatch..ready, measured at sync points)
+  data   — host batch assembly + transfer
+  eval   — test/validation passes
+
+Use ``jax.profiler`` traces when per-op attribution is needed; these
+counters are the always-on cheap layer, like the reference's.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class Timers:
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._acc: dict[str, float] = {}
+        self._n: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._n[name] = self._n.get(name, 0) + 1
+
+    def total(self, name: str) -> float:
+        return self._acc.get(name, 0.0)
+
+    def mean_ms(self, name: str) -> float:
+        n = self._n.get(name, 0)
+        return (self._acc.get(name, 0.0) / n * 1000.0) if n else 0.0
+
+    def to_string(self) -> str:
+        """"train 12.3ms, data 0.8ms" — the TimerInfo display line."""
+        return ", ".join(
+            f"{k} {self.mean_ms(k):.2f}ms/it" for k in sorted(self._acc)
+        ) or "no timing"

@@ -1,0 +1,24 @@
+"""Net-structure observability: node-link JSON dumps.
+
+The reference writes a JSON graph per phase into the cluster's
+vis_subfolder for script/graph.py to render (NeuralNet::ToString,
+src/worker/neuralnet.cc:325-332; Cluster::vis_folder,
+include/utils/cluster.h:70-73). Net.to_json produces the same node-link
+shape; this writes it where the reference would.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..graph.builder import Net
+
+
+def dump_net_json(net: Net, folder: str) -> str:
+    """Write <folder>/<phase>.json; returns the path."""
+    os.makedirs(folder, exist_ok=True)
+    path = os.path.join(folder, f"{net.phase}.json")
+    with open(path, "w") as f:
+        json.dump(net.to_json(), f, indent=2)
+    return path

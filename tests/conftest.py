@@ -8,12 +8,18 @@ import os
 
 # Force CPU even when the environment pre-sets a real accelerator platform
 # (e.g. JAX_PLATFORMS=axon for the tunneled TPU, reserved for bench.py).
+# The env var alone is not enough: this image's sitecustomize re-pins the
+# platform, so pin it again through jax.config after import.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

@@ -1,0 +1,259 @@
+"""Trainer end-to-end tests: the SURVEY §7 step-6 gate.
+
+Covers the vertical slice config -> net -> params -> jitted train step ->
+cadence loop -> accuracy, on real (sklearn digits) and synthetic shards.
+MNIST idx files are not on disk in this image (zero egress), so digits is
+the accuracy-parity stand-in; the full-size MNIST path is exercised by the
+same code via examples/mnist/mlp.conf when the shards exist.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu.config import load_model_config, parse_model_config
+from singa_tpu.config.schema import ClusterConfig
+from singa_tpu.data.loader import digits_arrays, synthetic_arrays, write_records
+from singa_tpu.trainer import Trainer, load_checkpoint
+
+MLP_CONF = """
+name: "test-mlp"
+train_steps: {train_steps}
+test_steps: 4
+test_frequency: {test_frequency}
+display_frequency: 0
+checkpoint_frequency: {checkpoint_frequency}
+updater {{
+  base_learning_rate: {lr}
+  learning_rate_change_method: kFixed
+  momentum: 0.9
+  type: kSGD
+}}
+neuralnet {{
+  layer {{
+    name: "data"
+    type: "kShardData"
+    data_param {{ path: "{train_shard}" batchsize: {batchsize} }}
+    exclude: kTest
+  }}
+  layer {{
+    name: "data"
+    type: "kShardData"
+    data_param {{ path: "{test_shard}" batchsize: 128 }}
+    exclude: kTrain
+  }}
+  layer {{
+    name: "mnist"
+    type: "kMnistImage"
+    srclayers: "data"
+    mnist_param {{ norm_a: 127.5 norm_b: 1 }}
+  }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{
+    name: "fc1"
+    type: "kInnerProduct"
+    srclayers: "mnist"
+    inner_product_param {{ num_output: 64 }}
+    param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
+    param {{ name: "bias" init_method: kConstant value: 0 }}
+  }}
+  layer {{ name: "tanh1" type: "kTanh" srclayers: "fc1" }}
+  layer {{
+    name: "fc2"
+    type: "kInnerProduct"
+    srclayers: "tanh1"
+    inner_product_param {{ num_output: 10 }}
+    param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
+    param {{ name: "bias" init_method: kConstant value: 0 }}
+  }}
+  layer {{
+    name: "loss"
+    type: "kSoftmaxLoss"
+    softmaxloss_param {{ topk: 1 }}
+    srclayers: "fc2"
+    srclayers: "label"
+  }}
+}}
+"""
+
+
+def make_conf(
+    tmp_path,
+    train,
+    test,
+    *,
+    train_steps=60,
+    batchsize=64,
+    lr=0.05,
+    test_frequency=0,
+    checkpoint_frequency=0,
+):
+    train_dir = str(tmp_path / "train_shard")
+    test_dir = str(tmp_path / "test_shard")
+    write_records(train_dir, *train)
+    write_records(test_dir, *test)
+    return parse_model_config(
+        MLP_CONF.format(
+            train_shard=train_dir,
+            test_shard=test_dir,
+            train_steps=train_steps,
+            batchsize=batchsize,
+            lr=lr,
+            test_frequency=test_frequency,
+            checkpoint_frequency=checkpoint_frequency,
+        )
+    )
+
+
+def final_test_accuracy(trainer):
+    avg = trainer.evaluate(
+        trainer.test_net, trainer.cfg.test_steps, "test", trainer.cfg.train_steps
+    )
+    (m,) = avg.values()
+    return m["precision"]
+
+
+def test_trains_synthetic_to_high_accuracy(tmp_path):
+    cfg = make_conf(
+        tmp_path,
+        synthetic_arrays(640, seed=1),
+        synthetic_arrays(512, seed=1, noise_seed=2),
+        train_steps=40,
+        test_frequency=20,
+    )
+    logs = []
+    trainer = Trainer(cfg, seed=0, log=logs.append, prefetch=False)
+    trainer.run()
+    assert final_test_accuracy(trainer) >= 0.95
+    # the test cadence actually fired and logged
+    assert any("test" in line for line in logs)
+
+
+def test_trains_digits_to_reference_accuracy(tmp_path):
+    """Accuracy-parity bar on a real dataset (the digits stand-in for the
+    reference's ~98% MNIST MLP; worker.cc's 60k-step run compresses to a
+    few hundred on 1.4k images)."""
+    cfg = make_conf(
+        tmp_path,
+        digits_arrays("train"),
+        digits_arrays("test"),
+        train_steps=400,
+        lr=0.05,
+    )
+    trainer = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
+    trainer.run()
+    assert final_test_accuracy(trainer) >= 0.95
+
+
+def test_checkpoint_resume_reproduces_uninterrupted_run(tmp_path):
+    """Kill-and-resume reproduces the uninterrupted trajectory (the
+    contract Worker::Resume never implemented, worker.cc:65-67)."""
+    data = (synthetic_arrays(256, seed=1), synthetic_arrays(128, seed=1, noise_seed=2))
+
+    # uninterrupted: 20 steps
+    cfg_a = make_conf(tmp_path / "a", *data, train_steps=20)
+    t_a = Trainer(cfg_a, seed=3, log=lambda s: None, prefetch=False)
+    t_a.run()
+
+    # "crashed" run: the checkpoint_frequency cadence wrote step_10 before
+    # the process would have died mid-way
+    cluster = ClusterConfig()
+    cluster.workspace = str(tmp_path / "ws")
+    cfg_b = make_conf(
+        tmp_path / "b", *data, train_steps=14, checkpoint_frequency=10
+    )
+    t_b = Trainer(cfg_b, cluster, seed=3, log=lambda s: None, prefetch=False)
+    t_b.run()
+    ckpt = os.path.join(cluster.workspace, "checkpoints", "step_10.npz")
+    assert os.path.exists(ckpt)
+    step, params, state = load_checkpoint(ckpt)
+    assert step == 10
+    assert set(params) == set(t_a.params)
+
+    cfg_c = make_conf(tmp_path / "c", *data, train_steps=20)
+    cfg_c.checkpoint = ckpt
+    t_c = Trainer(cfg_c, seed=3, log=lambda s: None, prefetch=False)
+    assert t_c.start_step == 10
+    # resume consumes batches from the shard start; align the pipeline to
+    # where run a left off (10 steps into the stream) for bitwise replay
+    for pipe in t_c._pipelines[id(t_c.train_net)].values():
+        pipe._pos = (10 * 64) % pipe.n
+    t_c.run()
+
+    for name in t_a.params:
+        np.testing.assert_allclose(
+            np.asarray(t_a.params[name]),
+            np.asarray(t_c.params[name]),
+            rtol=2e-5,
+            atol=2e-6,
+            err_msg=f"param {name} diverged after resume",
+        )
+
+
+def test_mlp_conf_parses_and_builds(tmp_path):
+    """The repo's full-size mlp.conf builds nets + params end-to-end once
+    shards exist (the north-star 'job launches unchanged' contract)."""
+    conf_path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "mnist", "mlp.conf"
+    )
+    cfg = load_model_config(conf_path)
+    # point the shard paths into tmp and shrink for test time
+    images, labels = synthetic_arrays(64, seed=0)
+    for layer in cfg.neuralnet.layer:
+        if layer.type == "kShardData":
+            path = str(tmp_path / layer.data_param.path)
+            write_records(path, images, labels)
+            layer.data_param.path = path
+            layer.data_param.batchsize = 32
+            layer.data_param.random_skip = 0
+    cfg.train_steps = 2
+    cfg.test_steps = 1
+    cfg.display_frequency = 1
+    logs = []
+    trainer = Trainer(cfg, seed=0, log=logs.append, prefetch=False)
+    specs = trainer.specs
+    # the six FC layers declared their weights+biases
+    assert sum(1 for n in specs if n.endswith("/weight")) == 6
+    assert specs["fc1/weight"].shape == (784, 2500)
+    trainer.run()
+    assert any("train" in line for line in logs)
+
+
+def test_cli_entry_point(tmp_path, capsys):
+    """python -m singa_tpu.main -model_conf F -cluster_conf F: the
+    reference launch line (src/main.cc:13-18) works end to end."""
+    from singa_tpu.main import main
+
+    cfg_text = MLP_CONF.format(
+        train_shard=str(tmp_path / "train_shard"),
+        test_shard=str(tmp_path / "test_shard"),
+        train_steps=3,
+        batchsize=32,
+        lr=0.05,
+        test_frequency=2,
+        checkpoint_frequency=0,
+    )
+    write_records(str(tmp_path / "train_shard"), *synthetic_arrays(64, seed=1))
+    write_records(str(tmp_path / "test_shard"), *synthetic_arrays(64, seed=1, noise_seed=2))
+    model_conf = tmp_path / "job.conf"
+    model_conf.write_text(cfg_text)
+    cluster_conf = tmp_path / "cluster.conf"
+    cluster_conf.write_text(
+        f'nworkers: 1 workspace: "{tmp_path / "ws"}"'
+    )
+    rc = main(
+        [
+            "-model_conf", str(model_conf),
+            "-cluster_conf", str(cluster_conf),
+            "-procsID", "0",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "training 'test-mlp'" in out
+    assert "test" in out  # the test cadence fired
+    # the vis JSON graph dump landed in the workspace (neuralnet.cc:325-332)
+    assert (tmp_path / "ws" / "vis" / "kTrain.json").exists()
+    # the end-of-run checkpoint landed
+    assert (tmp_path / "ws" / "checkpoints" / "step_3.npz").exists()
