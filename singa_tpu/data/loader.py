@@ -39,8 +39,22 @@ def _key(i: int) -> str:
 def write_records(
     folder: str, images: np.ndarray, labels: np.ndarray, append: bool = True
 ) -> int:
-    """Write uint8 (N,H,W) images + labels as Records; returns #inserted."""
+    """Write uint8 (N,H,W) images + labels as Records; returns #inserted.
+
+    Fresh shards encode through the native C++ codec when built
+    (byte-identical output, singa_tpu/native); appends go through the
+    Python writer because its key set deduplicates against existing
+    records, matching the reference loader's resume semantics.
+    """
     images = np.asarray(images, dtype=np.uint8)
+    from .. import native
+    from .shard import shard_path
+
+    os.makedirs(folder, exist_ok=True)
+    if not (append and os.path.exists(shard_path(folder))):
+        fast = native.write_records(shard_path(folder), images, labels)
+        if fast is not None:
+            return fast
     n = 0
     with ShardWriter(folder, append=append) as w:
         for i, (img, label) in enumerate(zip(images, labels)):

@@ -27,7 +27,18 @@ def load_shard_arrays(folder: str) -> tuple[np.ndarray, np.ndarray]:
     Images come back as float32 with the record's own shape appended after
     the batch dim; uint8 ``pixel`` payloads are widened (the reference's
     cast-to-uint8-then-float dance, layer.cc:390-400).
+
+    Uniform-shape shards decode through the native C++ codec when built
+    (singa_tpu.native — the counterpart of the reference's C++ data layer);
+    anything it declines falls back to the Python codec below.
     """
+    from .. import native
+    from .shard import shard_path
+
+    fast = native.load_dataset(shard_path(folder))
+    if fast is not None:
+        return fast
+
     images: list[np.ndarray] = []
     labels: list[int] = []
     with ShardReader(folder) as reader:

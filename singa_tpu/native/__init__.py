@@ -1,0 +1,164 @@
+"""Native (C++) data-path acceleration, loaded via ctypes.
+
+The reference's data layer is all C++ (src/utils/shard.cc, the protobuf
+Record codec, tools/data_loader/); this package is its native counterpart
+here: `shardcodec.cc` scans shard files, decodes/encodes proto2 Records,
+and materializes whole datasets without Python in the per-record loop.
+
+The library builds on demand with g++ (one small TU, ~1s) into this
+directory; every entry point degrades gracefully to the pure-Python codec
+in singa_tpu.data when the toolchain or platform is unavailable, so the
+framework stays importable everywhere. `singa_tpu.data.pipeline` routes
+through `load_dataset` automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "shardcodec.cc")
+_LIB = os.path.join(_DIR, "libshardcodec.so")
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the codec; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(
+        _SRC
+    ):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        return None
+    lib.sc_scan.restype = ctypes.c_int64
+    lib.sc_scan.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.sc_load_dataset_alloc.restype = ctypes.c_int64
+    lib.sc_load_dataset_alloc.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.sc_free.restype = None
+    lib.sc_free.argtypes = [ctypes.c_void_p]
+    lib.sc_write_records.restype = ctypes.c_int64
+    lib.sc_write_records.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def scan(path: str) -> tuple[int, int] | None:
+    """(complete_tuple_count, valid_end_offset), or None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    end = ctypes.c_uint64(0)
+    n = lib.sc_scan(path.encode(), ctypes.byref(end))
+    if n < 0:
+        return None
+    return int(n), int(end.value)
+
+
+def load_dataset(path: str) -> tuple[np.ndarray, np.ndarray] | None:
+    """Decode all records of a uniform-shape shard in native code.
+
+    One file read end-to-end: the library scans, decodes, and returns
+    malloc'd dense arrays which are copied into numpy and freed.
+    -> (images float32 (N, *shape), labels int32 (N,)), or None when the
+    native path can't serve this shard (falls back to Python — e.g. mixed
+    per-record shapes).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    pixels_p = ctypes.POINTER(ctypes.c_float)()
+    labels_p = ctypes.POINTER(ctypes.c_int32)()
+    shape_buf = (ctypes.c_int32 * 8)()
+    ndim = ctypes.c_int32(0)
+    count = lib.sc_load_dataset_alloc(
+        path.encode(),
+        ctypes.byref(pixels_p),
+        ctypes.byref(labels_p),
+        shape_buf,
+        8,
+        ctypes.byref(ndim),
+    )
+    if count <= 0:
+        return None  # absent/empty/non-uniform: Python path handles it
+    try:
+        shape = tuple(shape_buf[i] for i in range(ndim.value))
+        sample = int(np.prod(shape))
+        images = np.ctypeslib.as_array(pixels_p, (int(count), sample)).copy()
+        labels = np.ctypeslib.as_array(labels_p, (int(count),)).copy()
+    finally:
+        lib.sc_free(pixels_p)
+        lib.sc_free(labels_p)
+    return images.reshape((int(count), *shape)), labels
+
+
+def write_records(
+    path: str,
+    images: np.ndarray,
+    labels: np.ndarray,
+    start_index: int = 0,
+    append: bool = False,
+) -> int | None:
+    """Encode + write uint8 image records natively; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    labels = np.ascontiguousarray(labels, dtype=np.int32)
+    shape = (ctypes.c_int32 * (images.ndim - 1))(*images.shape[1:])
+    n = lib.sc_write_records(
+        path.encode(),
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(images),
+        shape,
+        images.ndim - 1,
+        start_index,
+        1 if append else 0,
+    )
+    return int(n) if n >= 0 else None

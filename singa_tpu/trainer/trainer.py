@@ -133,6 +133,13 @@ class Trainer:
                 for l in net.datalayers
             }
 
+        if model_cfg.checkpoint_frequency and self._checkpoint_dir() is None:
+            self.log(
+                "WARNING: checkpoint_frequency is set but no cluster "
+                "workspace is configured — no snapshots will be written "
+                "(pass -cluster_conf with a workspace field)"
+            )
+
         # --- the one compiled program ---
         self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
         self._eval_steps: dict[int, Callable] = {}

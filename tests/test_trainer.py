@@ -257,3 +257,30 @@ def test_cli_entry_point(tmp_path, capsys):
     assert (tmp_path / "ws" / "vis" / "kTrain.json").exists()
     # the end-of-run checkpoint landed
     assert (tmp_path / "ws" / "checkpoints" / "step_3.npz").exists()
+
+
+def test_lenet_conv_conf_trains_digits(tmp_path):
+    """examples/mnist/conv.conf (the reference's LeNet workload: conv20k5 ->
+    maxpool2 -> conv50k5 -> maxpool2 -> fc500 -> relu -> fc10) trains on
+    digits through the conv/pool/relu path with kUniformSqrtFanIn inits,
+    per-param lr multipliers, and the kInverse LR schedule."""
+    write_records(str(tmp_path / "train_shard"), *digits_arrays("train"))
+    write_records(str(tmp_path / "test_shard"), *digits_arrays("test"))
+    conf_path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "mnist", "conv.conf"
+    )
+    cfg = load_model_config(conf_path)
+    for layer in cfg.neuralnet.layer:
+        if layer.type == "kShardData":
+            layer.data_param.path = str(tmp_path / layer.data_param.path)
+    cfg.train_steps = 250
+    cfg.test_steps = 3
+    cfg.test_frequency = 0
+    cfg.display_frequency = 0
+    trainer = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
+    # conv weights in the reference's (num_filters, c*k*k) col layout
+    assert trainer.specs["conv1/weight"].shape == (20, 25)
+    assert trainer.specs["conv2/weight"].shape == (50, 500)
+    assert trainer.specs["conv1/bias"].lr_mult == 2.0
+    trainer.run()
+    assert final_test_accuracy(trainer) >= 0.93
