@@ -16,7 +16,7 @@ import argparse
 import sys
 
 from .config import load_cluster_config, load_model_config
-from .trainer import Trainer
+from .trainer import make_trainer
 
 
 def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
@@ -37,7 +37,7 @@ def main(argv: list[str] | None = None) -> int:
     cluster_cfg = (
         load_cluster_config(args.cluster_conf) if args.cluster_conf else None
     )
-    trainer = Trainer(model_cfg, cluster_cfg, seed=args.seed)
+    trainer = make_trainer(model_cfg, cluster_cfg, seed=args.seed)
     trainer.log(
         f"training {model_cfg.name!r}: steps "
         f"[{trainer.start_step}, {model_cfg.train_steps}), "

@@ -10,6 +10,13 @@ grad sync over ICI, all-gather/reduce-scatter for layer partitions) that the
 reference implemented by hand over TCP.
 """
 
+from .consistency import (
+    elastic_sync,
+    random_sync,
+    sample_sync_indices,
+    sync_now,
+    sync_ratio,
+)
 from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, mesh_from_cluster
 from .shardings import (
     batch_shardings,
@@ -27,4 +34,9 @@ __all__ = [
     "param_shardings",
     "replicated",
     "state_shardings",
+    "elastic_sync",
+    "random_sync",
+    "sample_sync_indices",
+    "sync_now",
+    "sync_ratio",
 ]

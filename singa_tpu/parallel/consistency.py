@@ -1,0 +1,157 @@
+"""Async consistency protocols (EASGD / RandomSync / hogwild), TPU-native.
+
+The reference trains one model replica per worker group and reconciles the
+replicas through a ZeroMQ parameter server running one of two protocols
+(src/utils/param.cc:100-256), throttled by a bandwidth-adaptive sample
+ratio (src/worker/param_manager.cc:85-93) on the SyncNow cadence
+(param_manager.cc:155-159). Here the server tier dissolves: replicas live
+on a leading array axis sharded over the mesh's data axis, and each
+protocol becomes a pure, jit-compiled transform over that axis. The
+server processed worker messages serially under a per-param lock
+(src/server/server.cc:110-143), so the faithful equivalent is a
+`lax.scan` over replicas with the server ("center") pytree as carry —
+order-dependent exactly like the reference, but one XLA program instead
+of a message storm.
+
+Protocols (semantics pinned by tests/test_consistency.py):
+
+- **Elastic (EASGD)** — worker ships its full vector w with moving rate
+  alpha; the server computes diff = alpha*(w - s), absorbs it (s += diff)
+  and returns diff; the worker subtracts it (w -= diff)
+  (param.cc:216-256).
+- **RandomSync** — the worker samples floor(ratio*n) coordinates without
+  replacement (reservoir-style, param.cc:101-110; distributionally
+  equivalent sampling here), ships delta = w[idx] - snapshot[idx]; the
+  server adds each delta and returns its *pre-update* value old;
+  the worker reconciles w[idx] = old + delta and refreshes the snapshot
+  (param.cc:112-196).
+- **hogwild** (UpdaterProto.hogwild, model.proto:316) was *intra-process*
+  lock-free sharing among executor threads. It has no TPU counterpart by
+  design: one XLA program already saturates a chip, so the
+  `nthreads_per_procs` replicas collapse into the batch dimension (see
+  singa_tpu/parallel/mesh.py). The flag is parsed and ignored.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync_now(step: int, sync_frequency: int, warmup_steps: int) -> bool:
+    """ParamManager::SyncNow (reference: param_manager.cc:155-159): every
+    ``sync_frequency`` steps once past warmup. ``step`` is the step just
+    completed."""
+    return (
+        sync_frequency > 0
+        and (step + 1) % sync_frequency == 0
+        and step > warmup_steps
+    )
+
+
+def sync_ratio(
+    compute_time_s: float,
+    model_mb: float,
+    nworkers: int,
+    nservers: int,
+    bandwidth_mbps: float,
+) -> float:
+    """ParamManager::SyncConfig (reference: param_manager.cc:85-93): the
+    bandwidth-adaptive RandomSync sample ratio. The cluster can absorb
+    ``bandwidth * nservers`` MB/s of sync traffic; the workers produce
+    ``model_mb * nworkers / compute_time`` MB/s; the ratio of the two is
+    the fraction of coordinates each sync can afford, clamped to 1."""
+    if compute_time_s <= 0 or model_mb <= 0:
+        return 1.0
+    produced = model_mb * nworkers / compute_time_s
+    ratio = bandwidth_mbps * max(nservers, 1) / produced
+    return float(min(ratio, 1.0))
+
+
+def elastic_sync(replicas, center, alpha: float):
+    """One EASGD round: every replica syncs with the center, serially.
+
+    ``replicas`` is a pytree whose leaves carry a leading replica axis;
+    ``center`` the matching server pytree. Returns (replicas, center).
+    Matches ElasticParam::{GenSyncMsgFromWorker,HandleSyncMsg,
+    ParseSyncMsgFromPS} (reference: src/utils/param.cc:216-256): for each
+    replica in turn, diff = alpha*(w - s); s += diff; w -= diff.
+    """
+
+    def one(c, w):
+        diff = jax.tree.map(lambda wi, ci: alpha * (wi - ci), w, c)
+        c = jax.tree.map(jnp.add, c, diff)
+        w = jax.tree.map(jnp.subtract, w, diff)
+        return c, w
+
+    center, replicas = jax.lax.scan(one, center, replicas)
+    return replicas, center
+
+
+def random_sync(replicas, snapshots, center, indices):
+    """One RandomSync round over sampled coordinates, serially per replica.
+
+    ``indices`` maps param name -> int32 (nreplicas, m) of flat coordinate
+    indices (unique within each row). Per replica i and param (reference:
+    src/utils/param.cc:112-196):
+
+        delta = w[idx] - snapshot[idx]        (GenSyncMsgFromWorker)
+        old   = s[idx];  s[idx] += delta      (HandleSyncMsg)
+        w[idx] = old + delta;  snapshot[idx] = w[idx]   (ParseSyncMsgFromPS)
+
+    so each replica absorbs exactly the other replicas' deltas that reached
+    the server before its own message. Returns (replicas, snapshots, center).
+    """
+
+    def one(c, xs):
+        w, snap, idx = xs
+        new_w, new_snap = {}, {}
+        for name in w:
+            shape = w[name].shape
+            wf = w[name].ravel()
+            sf = snap[name].ravel()
+            cf = c[name].ravel()
+            ix = idx[name]
+            delta = wf[ix] - sf[ix]
+            old = cf[ix]
+            cf = cf.at[ix].add(delta)
+            new_vals = old + delta
+            wf = wf.at[ix].set(new_vals)
+            sf = sf.at[ix].set(new_vals)
+            c[name] = cf.reshape(shape)
+            new_w[name] = wf.reshape(shape)
+            new_snap[name] = sf.reshape(shape)
+        return dict(c), (new_w, new_snap)
+
+    center, (replicas, snapshots) = jax.lax.scan(
+        one, dict(center), (replicas, snapshots, indices)
+    )
+    return replicas, snapshots, center
+
+
+def sample_sync_indices(
+    rng: np.random.RandomState,
+    shapes: dict[str, tuple],
+    nreplicas: int,
+    ratio: float,
+) -> dict[str, np.ndarray]:
+    """Host-side coordinate sampling for one RandomSync round.
+
+    Each replica draws its own coordinates (the reference seeds per-worker
+    from the wall clock, param.cc:146; parity is distributional). The
+    sample count m = floor(ratio*n) — the reference's float-to-int
+    truncation of data_.count()*sample_ratio (param.cc:148) — is static
+    per param so the jitted sync retraces only when the ratio changes
+    (it is fixed after warmup).
+    """
+    out: dict[str, np.ndarray] = {}
+    for name, shape in shapes.items():
+        n = int(np.prod(shape))
+        m = n if ratio >= 1.0 else max(1, int(n * ratio))
+        rows = [
+            np.sort(rng.choice(n, size=m, replace=False))
+            for _ in range(nreplicas)
+        ]
+        out[name] = np.stack(rows).astype(np.int32)
+    return out

@@ -1,6 +1,36 @@
 """Training engine (the reference's worker side, L5)."""
 
 from .checkpoint import load_checkpoint, restore_into, save_checkpoint
+from .replica import ReplicaTrainer
 from .trainer import Trainer
 
-__all__ = ["Trainer", "save_checkpoint", "load_checkpoint", "restore_into"]
+
+def make_trainer(model_cfg, cluster_cfg=None, **kwargs):
+    """Role dispatch, the TPU-native main.cc:49-55.
+
+    The reference picks worker-vs-server by process rank; here every
+    process trains, and the *consistency regime* is what the cluster
+    config selects: ``nservers > 0`` with an asynchronous cluster
+    (cluster.proto ``synchronous`` false) means PS-style replica training
+    under the configured protocol (param_type "Elastic"/"RandomSync");
+    otherwise the synchronous ParamSync Trainer — the north-star
+    replacement for the PS tier.
+    """
+    if (
+        cluster_cfg is not None
+        and cluster_cfg.nservers > 0
+        and not cluster_cfg.synchronous
+        and model_cfg.updater is not None
+    ):
+        return ReplicaTrainer(model_cfg, cluster_cfg, **kwargs)
+    return Trainer(model_cfg, cluster_cfg, **kwargs)
+
+
+__all__ = [
+    "Trainer",
+    "ReplicaTrainer",
+    "make_trainer",
+    "save_checkpoint",
+    "load_checkpoint",
+    "restore_into",
+]

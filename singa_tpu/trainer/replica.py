@@ -1,0 +1,392 @@
+"""ReplicaTrainer: worker-group replicas + async consistency protocols.
+
+The reference's cluster runs ``ngroups`` model replicas, each training on
+its own data and reconciling through the parameter-server protocols
+selected by UpdaterProto.param_type ("Elastic" | "RandomSync",
+src/worker/neuralnet.cc:35-44). This trainer reproduces that training
+regime TPU-natively: replicas live on a leading param-array axis sharded
+over the mesh's data axis, the per-replica step is ``vmap``-compiled (one
+XLA program trains *all* replicas), and the protocol rounds are the pure
+scan transforms in singa_tpu/parallel/consistency.py.
+
+Lifecycle parity with Worker::Start (src/worker/worker.cc:14-57):
+
+  1. every replica initializes its own params (different RNG folds —
+     ParamManager::InitParams, distributional parity with time-seeded rand)
+  2. ``warmup_steps`` local-only steps; their measured step time feeds
+     SyncConfig's bandwidth-adaptive sample ratio (param_manager.cc:85-93)
+  3. bootstrap: replica 0 publishes to the server, everyone else fetches
+     (worker.cc:50-55) — here: center := replica 0, all replicas := center
+  4. main loop: local update every step; protocol sync round every
+     ``sync_frequency`` steps (SyncNow, param_manager.cc:155-159)
+
+The driver for choosing this trainer mirrors the reference topology:
+``nservers > 0`` and an asynchronous cluster (cluster.proto ``synchronous``
+is false) mean PS-style training; otherwise singa_tpu uses the default
+synchronous ParamSync Trainer (the north-star replacement).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ClusterConfig, ConfigError, ModelConfig
+from ..parallel.consistency import (
+    elastic_sync,
+    random_sync,
+    sample_sync_indices,
+    sync_now,
+    sync_ratio,
+)
+from ..parallel.mesh import DATA_AXIS
+from ..parallel.shardings import replicated
+from ..params import init_params
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .trainer import Trainer
+
+PROTOCOLS = ("Elastic", "RandomSync")
+
+
+class ReplicaTrainer(Trainer):
+    """Trainer variant holding one param replica per data-axis mesh row."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        cluster_cfg: ClusterConfig | None = None,
+        *,
+        mesh=None,
+        seed: int = 0,
+        log: Callable[[str], None] = print,
+        prefetch: bool | None = None,
+    ):
+        ucfg = model_cfg.updater
+        if ucfg is None:
+            raise ConfigError("model config has no updater block")
+        if ucfg.param_type not in PROTOCOLS:
+            # the reference logs "Unkown parameter type" (neuralnet.cc:43)
+            raise ConfigError(
+                f"unknown param_type {ucfg.param_type!r} "
+                f"(expected one of {PROTOCOLS})"
+            )
+        # protocol attrs before super(): _materialize_params (called from
+        # the base ctor) and _resume consult them
+        self.protocol = ucfg.param_type
+        self.sync_frequency = ucfg.sync_frequency
+        self.warmup_steps = ucfg.warmup_steps
+        self.moving_rate = ucfg.moving_rate
+        # The adaptive ratio from SyncConfig, set at bootstrap. RandomSync
+        # uses it as the coordinate fraction; Elastic uses it as alpha when
+        # moving_rate is 0 — the reference passes sample_ratio_ into
+        # GenSyncMsgFromWorker whenever moving_rate_ is unset
+        # (param_manager.cc:190-194), whatever the registered protocol.
+        self.sample_ratio = 1.0
+        self._warmup_time = 0.0
+        self._warmup_timed = 0
+        self._sync_rng = np.random.RandomState(seed ^ 0x5EED)
+        self._sync_jit: Callable | None = None
+        super().__init__(
+            model_cfg,
+            cluster_cfg,
+            mesh=mesh,
+            seed=seed,
+            log=log,
+            prefetch=prefetch,
+        )
+        # each step consumes one batch per replica
+        self._batch_size = self.train_net.batchsize * self.nreplicas
+
+    def _materialize_params(self) -> None:
+        """Replica-axis params/state: leading axis over DATA_AXIS, any
+        kLayerPartition axes shift right by one. Each replica initializes
+        from its own RNG fold (ParamManager::InitParams — the reference
+        seeds per-process from the wall clock, so parity is
+        distributional)."""
+        self.nreplicas = self.mesh.shape[DATA_AXIS]
+        self._rep_param_sh = {
+            n: NamedSharding(self.mesh, P(DATA_AXIS, *sh.spec))
+            for n, sh in self.param_sh.items()
+        }
+        keys = jax.random.split(self._init_key, self.nreplicas)
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[init_params(k, self.specs) for k in keys],
+        )
+        self.params = {
+            n: jax.device_put(v, self._rep_param_sh[n])
+            for n, v in stacked.items()
+        }
+        # per-replica updater slots through the updater's own init contract
+        # (fresh state per replica = the single-replica init, replicated)
+        state0 = self.updater.init_state(
+            {n: v[0] for n, v in stacked.items()}
+        )
+        self.state = {
+            n: {
+                s: jax.device_put(
+                    jnp.broadcast_to(v, (self.nreplicas,) + v.shape),
+                    self._rep_param_sh[n],
+                )
+                for s, v in slots.items()
+            }
+            for n, slots in state0.items()
+        }
+        # server-side pytrees; materialized at bootstrap
+        self.center: dict[str, jnp.ndarray] | None = None
+        self.snapshot: dict[str, jnp.ndarray] | None = None
+        # bootstrapped means the PS holds a published model (worker.cc:50-55)
+        self._bootstrapped = False
+        if self.cfg.checkpoint:
+            self._resume(self.cfg.checkpoint)
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+
+    def _train_step_fn(self, params, state, step, batch, rng):
+        """vmap the per-replica forward/backward/update over the leading
+        replica axis; metrics are averaged across replicas (each group
+        reports its own Performance in the reference — one average is the
+        honest aggregate)."""
+        rngs = jax.random.split(rng, self.nreplicas)
+
+        def one(p, s, b, r):
+            def loss_fn(pp):
+                loss, metrics = self.train_net.forward(
+                    pp, b, training=True, rng=r
+                )
+                return loss, metrics
+
+            (_, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            p2, s2 = self.updater.apply(step, p, grads, s, self.specs)
+            return p2, s2, m
+
+        params, state, metrics = jax.vmap(
+            one, in_axes=(0, 0, 0, 0)
+        )(params, state, batch, rngs)
+        metrics = jax.tree.map(lambda x: jnp.mean(x, axis=0), metrics)
+        return params, state, metrics
+
+    def _build_sync(self):
+        if self.protocol == "Elastic":
+            # moving_rate if set, else the adaptive ratio — the reference's
+            # GenSyncMsgFromWorker argument choice (param_manager.cc:190-194)
+            alpha = self.moving_rate if self.moving_rate > 0 else self.sample_ratio
+
+            def fn(replicas, center):
+                return elastic_sync(replicas, center, alpha)
+
+            return jax.jit(fn)
+
+        def fn(replicas, snapshots, center, indices):
+            return random_sync(replicas, snapshots, center, indices)
+
+        return jax.jit(fn)
+
+    # ------------------------------------------------------------------
+    # host-side loop hooks
+    # ------------------------------------------------------------------
+
+    def _next_batch(self, net) -> dict:
+        """Train batches gain a leading replica axis: each replica consumes
+        its own ``batchsize`` records, in stream order — replica i gets the
+        i-th of ``nreplicas`` consecutive batches, like each worker group
+        reading its own shard partition (script/load_data.py semantics)."""
+        if net is not self.train_net:
+            return super()._next_batch(net)
+        out = {}
+        leaf_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        for name, pipe in self._pipelines[id(net)].items():
+            imgs, labels = [], []
+            for _ in range(self.nreplicas):
+                i, l = pipe.next_batch()
+                imgs.append(i)
+                labels.append(l)
+            out[name] = {
+                "image": jax.device_put(np.stack(imgs), leaf_sh),
+                "label": jax.device_put(np.stack(labels), leaf_sh),
+            }
+        return out
+
+    def train_one_batch(self, step: int) -> None:
+        import time
+
+        t0 = time.perf_counter()
+        super().train_one_batch(step)
+        if step < self.warmup_steps:
+            # block: dispatch is async, and SyncConfig needs real per-step
+            # compute time (the reference times the warmup loop wall-clock
+            # around synchronous CPU math, worker.cc:42-48). The first step
+            # of this process is excluded — it measures jit compilation.
+            jax.block_until_ready(self.params)
+            if step > self.start_step:
+                self._warmup_time += time.perf_counter() - t0
+                self._warmup_timed += 1
+        if not self._bootstrapped and step + 1 >= self.warmup_steps:
+            self._bootstrap()
+        if self._bootstrapped and sync_now(
+            step, self.sync_frequency, self.warmup_steps
+        ):
+            with self.timers.phase("sync"):
+                self._sync_round()
+
+    def _bootstrap(self) -> None:
+        """Group 0 publishes, others fetch (worker.cc:50-55): center :=
+        replica 0; every replica := center. Also runs SyncConfig with the
+        measured warmup step time (worker.cc:42-48)."""
+        self.center = jax.tree.map(lambda x: x[0], self.params)
+        self.params = jax.tree.map(
+            lambda c: jnp.broadcast_to(c, (self.nreplicas,) + c.shape),
+            self.center,
+        )
+        self.params = {
+            n: jax.device_put(v, self._rep_param_sh[n])
+            for n, v in self.params.items()
+        }
+        if self.protocol == "RandomSync":
+            # a genuine copy: the train step donates param buffers, so the
+            # snapshot must own separate storage (Elastic ships the full
+            # vector and keeps no snapshot, param.h:170-175)
+            self.snapshot = {n: jnp.copy(v) for n, v in self.params.items()}
+        needs_ratio = (
+            self.protocol == "RandomSync" or self.moving_rate <= 0
+        )
+        if needs_ratio and self.cluster is not None:
+            model_mb = sum(
+                int(np.prod(s.shape)) for s in self.specs.values()
+            ) * 4 / (1024 * 1024)
+            steps = max(self._warmup_timed, 1)
+            self.sample_ratio = sync_ratio(
+                self._warmup_time / steps,
+                model_mb,
+                self.cluster.nworkers or self.nreplicas,
+                self.cluster.nservers,
+                self.cluster.bandwidth,
+            )
+            self.log(f"Sample Ratio {self.sample_ratio}")
+        self._bootstrapped = True
+
+    def _sync_round(self) -> None:
+        if self._sync_jit is None:
+            self._sync_jit = self._build_sync()
+        if self.protocol == "Elastic":
+            self.params, self.center = self._sync_jit(
+                self.params, self.center
+            )
+        else:
+            shapes = {n: s.shape for n, s in self.specs.items()}
+            indices = sample_sync_indices(
+                self._sync_rng, shapes, self.nreplicas, self.sample_ratio
+            )
+            self.params, self.snapshot, self.center = self._sync_jit(
+                self.params, self.snapshot, self.center, indices
+            )
+
+    # ------------------------------------------------------------------
+    # eval / checkpoint / debug over the replica axis
+    # ------------------------------------------------------------------
+
+    def _eval_params(self):
+        """Evaluate replica 0's view (each reference group tests its own
+        replica; group 0 is the one whose params seed the server)."""
+        return {n: v[0] for n, v in self.params.items()}
+
+    def save(self, step: int):
+        path = super().save(step)
+        if path is not None and self.center is not None:
+            from .checkpoint import save_checkpoint
+
+            server = dict(self.center)
+            server["__sample_ratio__"] = jnp.float32(self.sample_ratio)
+            save_checkpoint(
+                path + ".server",
+                step,
+                server,
+                {"__snapshot__": self.snapshot} if self.snapshot else None,
+            )
+        return path
+
+    def _resume(self, path: str) -> None:
+        import os
+
+        from .checkpoint import restore_into
+
+        step, params, state = restore_into(path, self.params, self.state)
+        self.start_step = max(self.start_step, step)
+        # restore_into returns uncommitted host arrays — put them back on
+        # the replica shardings or the donating jit compiles unsharded
+        self.params = {
+            n: jax.device_put(v, self._rep_param_sh[n])
+            for n, v in params.items()
+        }
+        self.state = {
+            n: {
+                s: jax.device_put(v, self._rep_param_sh[n])
+                for s, v in slots.items()
+            }
+            for n, slots in state.items()
+        }
+        server = path + ".server"
+        if os.path.exists(server):
+            from .checkpoint import load_checkpoint
+
+            repl = replicated(self.mesh)
+            _, sv_params, sv_state = load_checkpoint(server)
+            ratio = sv_params.pop("__sample_ratio__", None)
+            if ratio is not None:
+                self.sample_ratio = float(ratio)
+            for n, v in sv_params.items():
+                if n in self.specs and tuple(v.shape) != self.specs[n].shape:
+                    raise ValueError(
+                        f"{server}: center param {n!r} shape {v.shape} "
+                        f"!= model shape {self.specs[n].shape}"
+                    )
+            self.center = {
+                n: jax.device_put(v, repl) for n, v in sv_params.items()
+            }
+            snap = sv_state.get("__snapshot__")
+            if self.protocol == "RandomSync" and snap:
+                self.snapshot = {
+                    n: jax.device_put(v, self._rep_param_sh[n])
+                    for n, v in snap.items()
+                }
+            self._bootstrapped = True
+        self.log(f"resumed from {path} at step {self.start_step}")
+
+    def debug_string(self, step: int) -> str:
+        """Replica-0 view of the per-layer dump, plus the replica↔center
+        spread (the quantity the protocols are supposed to bound)."""
+        batch = {
+            name: {k: v[0] for k, v in feed.items()}
+            for name, feed in self._last_batch.items()
+        }
+        rng = jax.random.fold_in(self._step_key, step)
+        p0 = self._eval_params()
+        _, _, acts = self.train_net.forward(
+            p0, batch, training=True, rng=rng, return_acts=True
+        )
+        lines = [
+            "debug: "
+            + ", ".join(
+                f"{name} {float(jnp.mean(jnp.abs(a))):.4g}"
+                for name, a in acts.items()
+                if hasattr(a, "dtype")
+            )
+        ]
+        if self.center is not None:
+            spread = {
+                n: float(
+                    jnp.max(jnp.abs(self.params[n] - self.center[n]))
+                )
+                for n in sorted(self.params)
+            }
+            lines.append(
+                "replica spread: "
+                + ", ".join(f"{n} {v:.4g}" for n, v in spread.items())
+            )
+        return "\n".join(lines)

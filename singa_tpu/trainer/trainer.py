@@ -83,19 +83,6 @@ class Trainer:
         self.updater = make_updater(model_cfg.updater)
         root = jax.random.PRNGKey(seed)
         self._init_key, self._step_key = jax.random.split(root)
-        params = init_params(self._init_key, self.specs)
-        state = self.updater.init_state(params)
-
-        # --- resume (fills Worker::Resume, worker.cc:65-67) ---
-        self.start_step = model_cfg.step
-        if model_cfg.checkpoint:
-            ck_step, params, state = restore_into(
-                model_cfg.checkpoint, params, state
-            )
-            self.start_step = max(self.start_step, ck_step)
-            self.log(
-                f"resumed from {model_cfg.checkpoint} at step {self.start_step}"
-            )
 
         # --- mesh + shardings (replaces Cluster/PS/partitioner) ---
         self.mesh = mesh if mesh is not None else mesh_from_cluster(cluster_cfg)
@@ -103,16 +90,10 @@ class Trainer:
         self.state_sh = state_shardings(self.param_sh, self.updater.SLOTS)
         self.batch_sh = batch_shardings(self.mesh, self.train_net)
         self._repl = replicated(self.mesh)
-        self.params = {
-            n: jax.device_put(v, self.param_sh[n]) for n, v in params.items()
-        }
-        self.state = {
-            n: {
-                s: jax.device_put(v, self.state_sh[n][s])
-                for s, v in slots.items()
-            }
-            for n, slots in state.items()
-        }
+
+        # --- params + resume, placed on the mesh ---
+        self.start_step = model_cfg.step
+        self._materialize_params()
 
         # --- input pipelines (prefetch thread; base_layer.h:510-537) ---
         if prefetch is None:
@@ -144,6 +125,35 @@ class Trainer:
         self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
         self._eval_steps: dict[int, Callable] = {}
         self._batch_size = self.train_net.batchsize
+
+    # ------------------------------------------------------------------
+    # param materialization (overridden by ReplicaTrainer)
+    # ------------------------------------------------------------------
+
+    def _materialize_params(self) -> None:
+        """Initialize params + updater slots, overlay the resume
+        checkpoint (fills Worker::Resume, worker.cc:65-67), and place
+        everything onto the mesh shardings."""
+        params = init_params(self._init_key, self.specs)
+        state = self.updater.init_state(params)
+        if self.cfg.checkpoint:
+            ck_step, params, state = restore_into(
+                self.cfg.checkpoint, params, state
+            )
+            self.start_step = max(self.start_step, ck_step)
+            self.log(
+                f"resumed from {self.cfg.checkpoint} at step {self.start_step}"
+            )
+        self.params = {
+            n: jax.device_put(v, self.param_sh[n]) for n, v in params.items()
+        }
+        self.state = {
+            n: {
+                s: jax.device_put(v, self.state_sh[n][s])
+                for s, v in slots.items()
+            }
+            for n, slots in state.items()
+        }
 
     # ------------------------------------------------------------------
     # compiled step functions
@@ -206,13 +216,19 @@ class Trainer:
             )
         self.perf.update(metrics)
 
+    def _eval_params(self):
+        """Params used by eval steps; replica trainers override this to
+        evaluate a single replica's view."""
+        return self.params
+
     def evaluate(self, net: Net, nsteps: int, phase: str, step: int) -> dict:
         """Test/Validate (worker.cc:318-348): nsteps batches, averaged."""
         fn = self._eval_step_for(net)
         perf = Performance()
+        eval_params = self._eval_params()
         with self.timers.phase("eval"):
             for _ in range(nsteps):
-                perf.update(fn(self.params, self._next_batch(net)))
+                perf.update(fn(eval_params, self._next_batch(net)))
         avg = perf.avg()
         self.log(f"step {step}: {phase} {perf.to_string()}")
         return avg
