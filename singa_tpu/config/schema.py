@@ -283,6 +283,23 @@ class InnerProductConfig(Message):
     }
 
 
+class RBMConfig(Message):
+    """singa-tpu extension: restricted Boltzmann machine hyperparams.
+
+    The reference declares the contrastive-divergence algorithm
+    (GradCalcAlg.kContrastiveDivergence, model.proto:40-44) but ships no CD
+    worker or RBM layer; this message parameterizes the greenfield kRBM
+    layer that fills that hole (BASELINE config 4)."""
+
+    FIELDS = {
+        "num_hidden": Field("int"),
+        "cd_k": Field("int", 1),
+        # sample (vs. use mean-field probabilities for) the visible units
+        # during Gibbs steps
+        "sample_visible": Field("bool", False),
+    }
+
+
 class LRNConfig(Message):
     FIELDS = {
         "local_size": Field("int", 5),
@@ -351,6 +368,7 @@ class LayerConfig(Message):
         "lrn_param": Field("message", message=LRNConfig),
         "mnist_param": Field("message", message=MnistConfig),
         "pooling_param": Field("message", message=PoolingConfig),
+        "rbm_param": Field("message", message=RBMConfig),
         "slice_param": Field("message", message=SliceConfig),
         "split_param": Field("message", message=SplitConfig),
         "relu_param": Field("message", message=ReLUConfig),

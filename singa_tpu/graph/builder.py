@@ -117,6 +117,7 @@ class Net:
         training: bool,
         rng: jax.Array | None = None,
         return_acts: bool = False,
+        layer_hook=None,
     ):
         """Run all layers; returns (total_loss, {losslayer: metrics}).
 
@@ -124,7 +125,11 @@ class Net:
         ({"image": ..., "label": ...}); shared params resolve through their
         owner's array (ParamSpec.owner). With ``return_acts`` the per-layer
         activation dict is appended — the debug-mode hook (the reference
-        dumps per-layer L1 norms, neuralnet.cc:350-378).
+        dumps per-layer L1 norms, neuralnet.cc:350-378). ``layer_hook``
+        optionally overrides a layer's compute: called as
+        hook(layer, resolved_params, inputs, layer_rng); a non-None return
+        replaces layer.apply — this is how the CD trainer swaps RBM layers
+        to Gibbs-chain updates without re-implementing the traversal.
         """
         resolved = dict(params)
         for layer in self.layers:
@@ -149,7 +154,13 @@ class Net:
                         val = val[k]
                     inputs.append(val)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            out = layer.apply(resolved, inputs, training=training, rng=lrng)
+            out = None
+            if layer_hook is not None:
+                out = layer_hook(layer, resolved, inputs, lrng)
+            if out is None:
+                out = layer.apply(
+                    resolved, inputs, training=training, rng=lrng
+                )
             if layer.is_losslayer:
                 loss, m = out
                 total_loss = total_loss + loss

@@ -24,7 +24,8 @@ from .data import (
     RGBImageLayer,
     ShardDataLayer,
 )
-from .loss import SoftmaxLossLayer
+from .loss import EuclideanLossLayer, SoftmaxLossLayer
+from .rbm import RBMLayer
 from .neuron import (
     ConvolutionLayer,
     DropoutLayer,
@@ -60,8 +61,11 @@ def registered_types() -> list[str]:
     return sorted(_REGISTRY)
 
 
-# the reference's 18 built-ins (neuralnet.cc:13-33) + kSigmoid extension
+# the reference's 18 built-ins (neuralnet.cc:13-33) + extensions:
+# kSigmoid, kRBM + kEuclideanLoss (the CD/autoencoder path, BASELINE #4)
 for _cls in (
+    RBMLayer,
+    EuclideanLossLayer,
     ConvolutionLayer,
     ConcateLayer,
     DropoutLayer,

@@ -101,6 +101,15 @@ class Layer:
         return f"{type(self).__name__}({self.name!r}, out={self.out_shape})"
 
 
+def feature_dim(shape: Shape) -> int:
+    """Product of the non-batch dims — the reference's flatten-to-(batch,
+    vdim) convention used by FC/RBM/loss layers (layer.cc:171-176)."""
+    out = 1
+    for d in shape[1:]:
+        out *= d
+    return out
+
+
 def require_one_src(layer: Layer, src_shapes: Sequence[Shape]) -> Shape:
     if len(src_shapes) != 1:
         raise ConfigError(

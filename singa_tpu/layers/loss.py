@@ -4,9 +4,11 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax.numpy as jnp
+
 from .. import ops
 from ..config.schema import ConfigError
-from .base import Layer, Shape
+from .base import Layer, Shape, feature_dim
 
 
 class SoftmaxLossLayer(Layer):
@@ -46,3 +48,38 @@ class SoftmaxLossLayer(Layer):
         return ops.softmax_loss(
             logits, labels, topk=self.topk, scale=self.scale
         )
+
+
+class EuclideanLossLayer(Layer):
+    """kEuclideanLoss: 0.5 * mean squared reconstruction error.
+
+    singa-tpu extension (no counterpart in this reference snapshot): the
+    regression/autoencoder loss needed by BASELINE config 4's deep
+    autoencoder, where the target srclayer is the input image itself.
+    Takes (prediction, target) srclayers; both are flattened to
+    (batch, -1). loss = 0.5/batch * sum((pred - target)^2).
+    """
+
+    TYPE = "kEuclideanLoss"
+    is_losslayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        if len(src_shapes) != 2:
+            raise ConfigError(
+                f"layer {self.name!r}: kEuclideanLoss needs (prediction, "
+                f"target) srclayers, got {len(src_shapes)}"
+            )
+        pdim = feature_dim(src_shapes[0])
+        tdim = feature_dim(src_shapes[1])
+        if pdim != tdim:
+            raise ConfigError(
+                f"layer {self.name!r}: prediction size {pdim} != target "
+                f"size {tdim}"
+            )
+        return src_shapes[0]
+
+    def apply(self, params, inputs, *, training, rng=None):
+        pred = inputs[0].reshape(inputs[0].shape[0], -1)
+        target = inputs[1].reshape(inputs[1].shape[0], -1)
+        loss = 0.5 * jnp.mean(jnp.sum(jnp.square(pred - target), axis=1))
+        return loss, {"loss": loss}

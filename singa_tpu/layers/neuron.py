@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from .. import ops
 from ..config.schema import ConfigError
-from .base import Layer, Shape, require_one_src
+from .base import Layer, Shape, feature_dim, require_one_src
 
 
 class ConvolutionLayer(Layer):
@@ -80,9 +80,7 @@ class InnerProductLayer(Layer):
                 f"layer {self.name!r}: inner_product_param.num_output required"
             )
         src = require_one_src(self, src_shapes)
-        vdim = 1
-        for d in src[1:]:
-            vdim *= d
+        vdim = feature_dim(src)
         self.vdim, self.hdim = vdim, p.num_output
         self.wname = self._declare_param(
             0,

@@ -1,21 +1,28 @@
 """Training engine (the reference's worker side, L5)."""
 
+from .cd import CDTrainer
 from .checkpoint import load_checkpoint, restore_into, save_checkpoint
 from .replica import ReplicaTrainer
 from .trainer import Trainer
 
 
 def make_trainer(model_cfg, cluster_cfg=None, **kwargs):
-    """Role dispatch, the TPU-native main.cc:49-55.
+    """Role + algorithm dispatch, the TPU-native main.cc:49-55.
 
     The reference picks worker-vs-server by process rank; here every
-    process trains, and the *consistency regime* is what the cluster
-    config selects: ``nservers > 0`` with an asynchronous cluster
-    (cluster.proto ``synchronous`` false) means PS-style replica training
-    under the configured protocol (param_type "Elastic"/"RandomSync");
-    otherwise the synchronous ParamSync Trainer — the north-star
-    replacement for the PS tier.
+    process trains, and two config axes select the engine:
+
+    - ModelProto.alg kContrastiveDivergence -> CDTrainer (the reference's
+      declared-but-never-built CD worker, model.proto:40-44); CD runs
+      synchronously.
+    - otherwise ``nservers > 0`` with an asynchronous cluster
+      (cluster.proto ``synchronous`` false) means PS-style replica
+      training under the configured protocol (param_type
+      "Elastic"/"RandomSync"); else the synchronous ParamSync Trainer —
+      the north-star replacement for the PS tier.
     """
+    if model_cfg.alg == "kContrastiveDivergence":
+        return CDTrainer(model_cfg, cluster_cfg, **kwargs)
     if (
         cluster_cfg is not None
         and cluster_cfg.nservers > 0
@@ -28,6 +35,7 @@ def make_trainer(model_cfg, cluster_cfg=None, **kwargs):
 
 __all__ = [
     "Trainer",
+    "CDTrainer",
     "ReplicaTrainer",
     "make_trainer",
     "save_checkpoint",

@@ -1,0 +1,131 @@
+"""Contrastive-divergence trainer (the reference's missing "CD worker").
+
+ModelProto.alg == kContrastiveDivergence is declared in the reference
+(src/proto/model.proto:40-44) with a TrainOneBatch comment splitting the
+worker into BP and CD variants (include/worker/base_layer.h:96-97), but no
+CD worker exists in that snapshot. This trainer fills the hole: the net is
+a chain data -> parsers -> kRBM+ (stacked RBMs), and one jitted step runs
+greedy layerwise CD — each RBM gets a CD-k update on the mean-field hidden
+activations of the (simultaneously training) RBM below it, the
+whole stack in a single XLA program. Stacked pretraining feeds a deep
+autoencoder: snapshot the pretrained stack, then kPretrained-init the
+unrolled MLP (kEuclideanLoss) and fine-tune with the default BP trainer.
+
+Reuses the whole Trainer cadence loop, updaters (momentum/weight-decay/LR
+schedules apply to CD grads exactly as they would to BP grads), mesh
+shardings, checkpointing, and observability.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..config.schema import ConfigError
+from ..layers.rbm import RBMLayer
+from .trainer import Trainer
+
+
+def unroll_autoencoder(
+    ckpt_in: str, ckpt_out: str, pairs: list[tuple[str, str]]
+) -> str:
+    """Unroll a pretrained RBM stack into autoencoder decoder weights.
+
+    For each (rbm_layer, decoder_layer) pair, the decoder InnerProduct
+    layer gets weight = rbm_weight^T and bias = rbm_vbias (the classic
+    Hinton unrolling); encoder layers pick their weights up by name, so
+    name the encoder's kInnerProduct layers after the RBMs. The result is
+    a checkpoint for ModelConfig.checkpoint / kPretrained init.
+    """
+    from .checkpoint import load_checkpoint, save_checkpoint
+
+    step, params, state = load_checkpoint(ckpt_in)
+    out = dict(params)
+    for rbm, dec in pairs:
+        w = params.get(f"{rbm}/weight")
+        vb = params.get(f"{rbm}/vbias")
+        if w is None or vb is None:
+            raise ConfigError(
+                f"checkpoint {ckpt_in!r} has no RBM params for {rbm!r}"
+            )
+        out[f"{dec}/weight"] = w.T
+        out[f"{dec}/bias"] = vb
+        # the encoder InnerProduct's bias is the RBM's hidden bias
+        out[f"{rbm}/bias"] = params[f"{rbm}/hbias"]
+    # step 0: fine-tuning starts a fresh step counter, not the CD one
+    return save_checkpoint(ckpt_out, 0, out)
+
+
+class CDTrainer(Trainer):
+    """Trainer whose compiled step does CD-k instead of backprop."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._rbms = [
+            l for l in self.train_net.layers if isinstance(l, RBMLayer)
+        ]
+        if not self._rbms:
+            raise ConfigError(
+                "alg kContrastiveDivergence requires at least one kRBM layer"
+            )
+        if self.train_net.losslayers:
+            raise ConfigError(
+                "kContrastiveDivergence is unsupervised: remove loss layers "
+                "(fine-tune the unrolled net with alg kBackPropagation)"
+            )
+        self._rbm_param_names = {
+            n for l in self._rbms for n in l.param_specs()
+        }
+
+    # ------------------------------------------------------------------
+
+    def _train_step_fn(self, params, state, step, batch, rng):
+        """One jitted CD step: walk the net through Net.forward (keeping
+        its shared-param and connector invariants), swapping each RBM's
+        compute for a Gibbs-chain update; then push the collected CD grads
+        through the regular updater. Grads never flow *between* RBMs —
+        greedy layerwise training by construction."""
+        grads: dict = {}
+        metrics: dict = {}
+
+        def hook(layer, resolved, inputs, lrng):
+            if isinstance(layer, RBMLayer):
+                g, m = layer.cd_grads(resolved, inputs[0], lrng)
+                grads.update(g)
+                metrics[layer.name] = m
+                return layer.prop_up(resolved, inputs[0])
+            return None
+
+        self.train_net.forward(
+            params, batch, training=True, rng=rng, layer_hook=hook
+        )
+        rbm_params = {n: params[n] for n in grads}
+        rbm_state = {n: state[n] for n in grads}
+        new_p, new_s = self.updater.apply(
+            step, rbm_params, grads, rbm_state, self.specs
+        )
+        params = {**params, **new_p}
+        state = {**state, **new_s}
+        return params, state, metrics
+
+    def _eval_step_for(self, net):
+        """Eval metric per RBM: mean-field reconstruction error."""
+        if id(net) not in self._eval_steps:
+
+            def eval_fn(params, batch):
+                metrics: dict = {}
+
+                def hook(layer, resolved, inputs, lrng):
+                    if isinstance(layer, RBMLayer):
+                        metrics[layer.name] = {
+                            "loss": layer.recon_error(resolved, inputs[0])
+                        }
+                        return layer.prop_up(resolved, inputs[0])
+                    return None
+
+                net.forward(
+                    params, batch, training=False, layer_hook=hook
+                )
+                return metrics
+
+            self._eval_steps[id(net)] = jax.jit(eval_fn)
+        return self._eval_steps[id(net)]

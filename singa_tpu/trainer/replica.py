@@ -350,11 +350,19 @@ class ReplicaTrainer(Trainer):
                 n: jax.device_put(v, repl) for n, v in sv_params.items()
             }
             snap = sv_state.get("__snapshot__")
-            if self.protocol == "RandomSync" and snap:
-                self.snapshot = {
-                    n: jax.device_put(v, self._rep_param_sh[n])
-                    for n, v in snap.items()
-                }
+            if self.protocol == "RandomSync":
+                if snap:
+                    self.snapshot = {
+                        n: jax.device_put(v, self._rep_param_sh[n])
+                        for n, v in snap.items()
+                    }
+                else:
+                    # sidecar from an Elastic run (no snapshot): refresh
+                    # snapshots from the restored replicas, like a fresh
+                    # RandomSyncParam::Init (param.cc:203-207)
+                    self.snapshot = {
+                        n: jnp.copy(v) for n, v in self.params.items()
+                    }
             self._bootstrapped = True
         self.log(f"resumed from {path} at step {self.start_step}")
 
