@@ -208,6 +208,13 @@ class RGBImageConfig(Message):
         "scale": Field("float", 1.0),
         "cropsize": Field("int", 0),
         "mirror": Field("bool", False),
+        # singa-tpu extension (matches the successor SINGA's
+        # rgbimage_param.meanfile): path to a mean.npy to subtract on
+        # device. This snapshot's reference subtracts the mean at loader
+        # time instead (tools/data_loader/data_source.cc:158-173); doing
+        # it in the parser keeps shards uint8 and lets XLA fuse the
+        # subtraction into the first conv.
+        "meanfile": Field("string"),
     }
 
 

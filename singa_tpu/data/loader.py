@@ -7,15 +7,24 @@ idx files are parsed with the same big-endian magic/meta layout
 
 Sources:
   mnist      train/test idx file pairs -> pixel-bytes records (shape 28x28)
+  cifar      CIFAR-10 binary batches (data_batch_*.bin / test_batch.bin,
+             1 label byte + 3072 RGB bytes per record) -> (3,32,32) records
   digits     sklearn load_digits upscaled to 28x28 — a real, learnable
              stand-in when the MNIST files aren't on disk (this image has no
              network egress); accuracy-parity tests train on this
-  synthetic  deterministic Gaussian-blob classes, for benchmarks/smoke tests
+  synthetic  deterministic Gaussian-blob classes (grayscale or RGB via
+             --channels), for benchmarks/smoke tests
+
+Mean files: ``compute-mean`` writes a per-pixel mean.npy over a shard, the
+counterpart of the reference's binaryproto image mean
+(data_source.cc:129-137); rgbimage_param.meanfile points at it.
 
 Usage:
   python -m singa_tpu.data.loader mnist  --image-file f --label-file f --output DIR
+  python -m singa_tpu.data.loader cifar  --bin-files f1 f2 ... --output DIR
   python -m singa_tpu.data.loader digits --output DIR [--split train|test]
-  python -m singa_tpu.data.loader synthetic --output DIR --n 1000 [--classes 10]
+  python -m singa_tpu.data.loader synthetic --output DIR --n 1000 [--classes 10] [--channels 3]
+  python -m singa_tpu.data.loader compute-mean --input DIR --output mean.npy
   python -m singa_tpu.data.loader split --input DIR --prefix P --n N [--mode equal|head]
 """
 
@@ -89,6 +98,35 @@ def read_idx_labels(path: str) -> np.ndarray:
     return np.frombuffer(buf, dtype=np.uint8)
 
 
+def read_cifar_bins(paths: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    """Parse CIFAR-10 binary batch files: each record is 1 label byte
+    followed by 3072 bytes (3 channels x 32x32, channel-major — already
+    the (C,H,W) layout our RGB records use)."""
+    rec = 1 + 3 * 32 * 32
+    images, labels = [], []
+    for path in paths:
+        buf = np.fromfile(path, dtype=np.uint8)
+        if buf.size % rec:
+            raise ValueError(
+                f"{path}: size {buf.size} is not a multiple of {rec}"
+            )
+        rows = buf.reshape(-1, rec)
+        labels.append(rows[:, 0])
+        images.append(rows[:, 1:].reshape(-1, 3, 32, 32))
+    return np.concatenate(images), np.concatenate(labels)
+
+
+def compute_mean(folder: str, out_path: str) -> np.ndarray:
+    """Per-pixel float32 mean over every record in a shard, saved as .npy
+    (the reference's mean binaryproto, data_source.cc:129-137)."""
+    from .pipeline import load_shard_arrays
+
+    images, _ = load_shard_arrays(folder)
+    mean = images.astype(np.float64).mean(axis=0).astype(np.float32)
+    np.save(out_path, mean)
+    return mean
+
+
 def digits_arrays(split: str = "train") -> tuple[np.ndarray, np.ndarray]:
     """sklearn 8x8 digits, nearest-upscaled to 28x28 uint8 images."""
     from sklearn.datasets import load_digits
@@ -112,18 +150,21 @@ def synthetic_arrays(
     size: int = 28,
     seed: int = 0,
     noise_seed: int | None = None,
+    channels: int = 0,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Gaussian class-template blobs: learnable, deterministic, no IO.
 
     ``seed`` fixes the class templates, ``noise_seed`` the per-sample noise —
     pass different noise seeds to get disjoint train/test splits of the same
-    classification problem.
+    classification problem. ``channels`` > 0 makes (C,H,W) RGB-style
+    records (CIFAR-shaped with channels=3, size=32).
     """
     rng = np.random.RandomState(seed)
-    templates = rng.rand(classes, size, size) * 160.0
+    shape = (channels, size, size) if channels else (size, size)
+    templates = rng.rand(classes, *shape) * 160.0
     labels = (np.arange(n) % classes).astype(np.uint8)
     nrng = rng if noise_seed is None else np.random.RandomState(noise_seed)
-    noise = nrng.rand(n, size, size) * 95.0
+    noise = nrng.rand(n, *shape) * 95.0
     images = (templates[labels] + noise).clip(0, 255).astype(np.uint8)
     return images, labels
 
@@ -168,6 +209,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--label-file", required=True)
     p.add_argument("--output", required=True)
 
+    p = sub.add_parser("cifar")
+    p.add_argument("--bin-files", nargs="+", required=True)
+    p.add_argument("--output", required=True)
+
     p = sub.add_parser("digits")
     p.add_argument("--output", required=True)
     p.add_argument("--split", choices=("train", "test"), default="train")
@@ -178,6 +223,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--size", type=int, default=28)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--channels", type=int, default=0)
+
+    p = sub.add_parser("compute-mean")
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
 
     p = sub.add_parser("split")
     p.add_argument("--input", required=True)
@@ -192,13 +242,22 @@ def main(argv: list[str] | None = None) -> int:
         if len(images) != len(labels):
             raise ValueError("image/label count mismatch")
         n = write_records(args.output, images, labels)
+    elif args.source == "cifar":
+        n = write_records(args.output, *read_cifar_bins(args.bin_files))
     elif args.source == "digits":
         n = write_records(args.output, *digits_arrays(args.split))
     elif args.source == "synthetic":
         n = write_records(
             args.output,
-            *synthetic_arrays(args.n, args.classes, args.size, args.seed),
+            *synthetic_arrays(
+                args.n, args.classes, args.size, args.seed,
+                channels=args.channels,
+            ),
         )
+    elif args.source == "compute-mean":
+        mean = compute_mean(args.input, args.output)
+        print(f"mean {tuple(mean.shape)} -> {args.output}")
+        return 0
     else:
         split_shard(args.input, args.prefix, args.n, args.mode)
         print(f"split {args.input} -> {args.prefix}-*")

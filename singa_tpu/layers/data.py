@@ -116,6 +116,15 @@ class RGBImageLayer(Layer):
         if len(src) != 4:
             raise ConfigError(f"layer {self.name!r}: expects (N,C,H,W) records")
         n, c, h, w = src
+        self.mean = None
+        if p and p.meanfile:
+            mean = np.load(p.meanfile)
+            if tuple(mean.shape) != (c, h, w):
+                raise ConfigError(
+                    f"layer {self.name!r}: meanfile shape {mean.shape} != "
+                    f"record shape {(c, h, w)}"
+                )
+            self.mean = mean.astype(np.float32)
         if self.cropsize:
             return (n, c, self.cropsize, self.cropsize)
         return src
@@ -124,6 +133,10 @@ class RGBImageLayer(Layer):
         import jax
 
         x = inputs[0]["image"].astype(jnp.float32)
+        if self.mean is not None:
+            # full-size mean subtracted before crop, like the loader-side
+            # subtraction in data_source.cc:158-173
+            x = x - jnp.asarray(self.mean)
         n, c, h, w = x.shape
         if self.cropsize:
             cs = self.cropsize

@@ -43,14 +43,15 @@ def unroll_autoencoder(
     for rbm, dec in pairs:
         w = params.get(f"{rbm}/weight")
         vb = params.get(f"{rbm}/vbias")
-        if w is None or vb is None:
+        hb = params.get(f"{rbm}/hbias")
+        if w is None or vb is None or hb is None:
             raise ConfigError(
                 f"checkpoint {ckpt_in!r} has no RBM params for {rbm!r}"
             )
         out[f"{dec}/weight"] = w.T
         out[f"{dec}/bias"] = vb
         # the encoder InnerProduct's bias is the RBM's hidden bias
-        out[f"{rbm}/bias"] = params[f"{rbm}/hbias"]
+        out[f"{rbm}/bias"] = hb
     # step 0: fine-tuning starts a fresh step counter, not the CD one
     return save_checkpoint(ckpt_out, 0, out)
 
@@ -72,9 +73,6 @@ class CDTrainer(Trainer):
                 "kContrastiveDivergence is unsupervised: remove loss layers "
                 "(fine-tune the unrolled net with alg kBackPropagation)"
             )
-        self._rbm_param_names = {
-            n for l in self._rbms for n in l.param_specs()
-        }
 
     # ------------------------------------------------------------------
 
