@@ -1,0 +1,18 @@
+"""Programmatic model families.
+
+The reference declares (but leaves empty) programmatic net construction —
+NeuralNet::AddLayer, include/worker/neuralnet.h:61-65 — alongside its
+proto-driven builder. This package is that surface made real: models
+built directly against the op vocabulary, for families beyond the
+config schema's layer types (currently the transformer LM that makes
+long-context/sequence-parallel training first-class).
+"""
+
+from .transformer import (
+    TransformerConfig,
+    init_lm,
+    lm_apply,
+    lm_loss,
+)
+
+__all__ = ["TransformerConfig", "init_lm", "lm_apply", "lm_loss"]

@@ -1,0 +1,140 @@
+"""Decoder-only transformer LM, TPU-first.
+
+No counterpart exists in the reference (pre-transformer system, SURVEY
+§5); this family exists to make long-context training first-class. The
+design keeps the framework's conventions: params are a flat name-keyed
+pytree (like the layer zoo's "<layer>/<param>" naming), the forward is a
+pure function traced into one jitted step, and distribution is sharding
+metadata, not code:
+
+- attn="flash" routes through the Pallas flash kernel
+  (singa_tpu/ops/attention.py) on TPU;
+- attn="ring" shards the sequence dim over a mesh axis and streams K/V
+  around the ICI ring (singa_tpu/parallel/ring.py) — context length
+  scales linearly with ring size;
+- the batch dim shards over any "data" mesh axis exactly like the
+  proto-driven nets (grad psum = ParamSync).
+
+Weights use bf16-friendly shapes (head_dim, d_ff multiples of 128 map
+cleanly onto the MXU); compute dtype is the caller's choice via the
+params' dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention, flash_attention
+from ..parallel.ring import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 1024
+    max_len: int = 1024
+    attn: str = "dense"  # dense | flash | ring
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_lm(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Flat name-keyed param pytree; scaled-normal init."""
+    params: dict[str, jnp.ndarray] = {}
+
+    def norm(key, shape, scale):
+        return scale * jax.random.normal(key, shape, dtype=jnp.float32)
+
+    keys = iter(jax.random.split(rng, 4 + 6 * cfg.n_layers))
+    params["embed/tok"] = norm(next(keys), (cfg.vocab, cfg.d_model), 0.02)
+    params["embed/pos"] = norm(next(keys), (cfg.max_len, cfg.d_model), 0.02)
+    for i in range(cfg.n_layers):
+        p = f"blk{i}"
+        d, f = cfg.d_model, cfg.d_ff
+        params[f"{p}/ln1/scale"] = jnp.ones((d,))
+        params[f"{p}/ln1/bias"] = jnp.zeros((d,))
+        params[f"{p}/attn/qkv"] = norm(next(keys), (d, 3 * d), 1 / math.sqrt(d))
+        params[f"{p}/attn/out"] = norm(
+            next(keys), (d, d), 1 / math.sqrt(d * 2 * cfg.n_layers)
+        )
+        params[f"{p}/ln2/scale"] = jnp.ones((d,))
+        params[f"{p}/ln2/bias"] = jnp.zeros((d,))
+        params[f"{p}/mlp/up"] = norm(next(keys), (d, f), 1 / math.sqrt(d))
+        params[f"{p}/mlp/down"] = norm(
+            next(keys), (f, d), 1 / math.sqrt(f * 2 * cfg.n_layers)
+        )
+    params["ln_f/scale"] = jnp.ones((cfg.d_model,))
+    params["ln_f/bias"] = jnp.zeros((cfg.d_model,))
+    return params
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _attend(q, k, v, cfg: TransformerConfig, mesh):
+    if cfg.attn == "ring":
+        if mesh is None:
+            raise ValueError("attn='ring' requires a mesh with a seq axis")
+        return ring_attention(q, k, v, mesh, causal=True)
+    if cfg.attn == "flash":
+        return flash_attention(q, k, v, True)
+    return attention(q, k, v, causal=True)
+
+
+def lm_apply(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """tokens (B, S) int32 -> logits (B, S, vocab); causal."""
+    b, s = tokens.shape
+    x = params["embed/tok"][tokens] + params["embed/pos"][:s]
+    for i in range(cfg.n_layers):
+        p = f"blk{i}"
+        h = _layernorm(x, params[f"{p}/ln1/scale"], params[f"{p}/ln1/bias"])
+        qkv = h @ params[f"{p}/attn/qkv"]
+        qkv = qkv.reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
+        # (B, H, S, D)
+        q, k, v = (
+            jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3)
+        )
+        o = _attend(q, k, v, cfg, mesh)
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, cfg.d_model)
+        x = x + o @ params[f"{p}/attn/out"]
+        h = _layernorm(x, params[f"{p}/ln2/scale"], params[f"{p}/ln2/bias"])
+        h = jax.nn.gelu(h @ params[f"{p}/mlp/up"])
+        x = x + h @ params[f"{p}/mlp/down"]
+    x = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+    return x @ params["embed/tok"].T
+
+
+def lm_loss(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """Next-token cross entropy, mean over all predicting positions.
+
+    The forward runs on the full (ring-divisible) sequence; the loss
+    drops the last position's prediction instead of trimming the input,
+    so ring sharding never sees an odd S-1 length."""
+    logits = lm_apply(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    targets = tokens[:, 1:]
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -1,0 +1,216 @@
+"""Attention ops: reference softmax attention, a Pallas TPU
+flash-attention kernel, and the online-softmax block primitives that
+ring attention (singa_tpu/parallel/ring.py) stitches across chips.
+
+The reference system predates transformers — no attention op exists
+anywhere in it (layer registry, src/worker/neuralnet.cc:13-33) — so this
+is a singa-tpu extension making long-context models first-class. The
+kernel follows the standard flash recipe: stream K/V blocks through VMEM,
+keep running (max, sum, output) statistics per query block so the S x S
+score matrix never materializes in HBM; the MXU sees (Bq, D) x (D, Bk)
+and (Bq, Bk) x (Bk, D) matmuls.
+
+All shapes are (batch, heads, seq, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Reference dense attention: softmax(QK^T / sqrt(d)) V."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+
+# ---------------------------------------------------------------------
+# online-softmax block math (shared by the Pallas kernel and ring
+# attention): process one K/V block, fold into running (out, m, l)
+# ---------------------------------------------------------------------
+
+
+def block_attn_update(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,
+    m: jnp.ndarray,
+    l: jnp.ndarray,
+    *,
+    q_offset=0,
+    k_offset=0,
+    causal: bool = False,
+):
+    """Fold one K/V block into running flash statistics.
+
+    q (..., Sq, D); k/v (..., Sk, D); out (..., Sq, D) unnormalized;
+    m/l (..., Sq) running rowmax / normalizer. Offsets give the global
+    positions of the local blocks so causal masking works when the
+    sequence is sharded (ring attention) or blocked (the kernel).
+    Returns the updated (out, m, l).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / math.sqrt(d)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[-2])
+        kpos = k_offset + jnp.arange(k.shape[-2])
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    out = out * alpha[..., None] + jnp.einsum("...qk,...kd->...qd", p, v)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    return out, m_new, l
+
+
+def block_attn_init(q_like: jnp.ndarray):
+    """Zero-state (out, m, l) for block_attn_update accumulation.
+
+    Derived arithmetically from ``q_like`` (not via zeros()) so that
+    under shard_map the state inherits q's varying-axis type and can
+    serve as a fori_loop carry (JAX's vma tracking)."""
+    out = q_like * 0.0
+    m = q_like[..., 0] * 0.0 + NEG_INF
+    l = q_like[..., 0] * 0.0
+    return out, m, l
+
+
+def block_attn_finish(out, m, l):
+    """Normalize accumulated output (fully-masked rows emit zeros)."""
+    safe = jnp.where(l == 0.0, 1.0, l)
+    return out / safe[..., None]
+
+
+# ---------------------------------------------------------------------
+# Pallas flash-attention kernel
+# ---------------------------------------------------------------------
+
+try:  # pallas import kept soft: CPU-only environments use interpret mode
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_k):
+    """One (batch*head, q-block) program: stream K/V blocks via VMEM.
+
+    Refs are (1, Bq, D) for q/o and (1, Sk, D) for k/v; accumulation in
+    fp32 registers/VMEM values (flash statistics never touch HBM).
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    bq, d = q.shape
+    out = jnp.zeros((bq, d), dtype=jnp.float32)
+    m = jnp.full((bq,), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((bq,), dtype=jnp.float32)
+    nblocks = seq_k // block_k
+    q_offset = qi * bq
+
+    def body(i, carry):
+        out, m, l = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        return block_attn_update(
+            q, k, v, out, m, l,
+            q_offset=q_offset, k_offset=i * block_k, causal=causal,
+        )
+
+    if causal:
+        # only K blocks at or below this q block's diagonal contribute
+        nblocks_live = jax.lax.div(q_offset + bq - 1, block_k) + 1
+        out, m, l = jax.lax.fori_loop(0, nblocks_live, body, (out, m, l))
+    else:
+        out, m, l = jax.lax.fori_loop(0, nblocks, body, (out, m, l))
+    o_ref[0] = block_attn_finish(out, m, l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal=False, block_q=128, block_k=128, interpret=None
+):
+    """Flash attention: Pallas forward, reference-math backward.
+
+    Falls back to the dense reference when Pallas is unavailable or the
+    sequence does not tile evenly. ``interpret=True`` runs the kernel in
+    the Pallas interpreter (CPU testing); default auto-detects TPU.
+    """
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _use_kernel(q, block_q, block_k, interpret):
+    if not HAS_PALLAS:
+        return False
+    s = q.shape[2]
+    if s % block_q or s % block_k:
+        return False
+    if interpret is None:
+        return jax.default_backend() == "tpu"
+    return True
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    if not _use_kernel(q, block_q, block_k, interpret):
+        return attention(q, k, v, causal=causal)
+    b, h, s, d = q.shape
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, s, d)
+    vf = v.reshape(bh, s, d)
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, block_k=block_k, seq_k=s
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=bool(interpret),
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    """Backward through the dense reference math (recompute): exact
+    gradients, O(S^2) flops like any attention backward, no extra
+    forward residuals kept in HBM."""
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
