@@ -55,7 +55,7 @@ def init_lm(rng: jax.Array, cfg: TransformerConfig) -> dict:
     def norm(key, shape, scale):
         return scale * jax.random.normal(key, shape, dtype=jnp.float32)
 
-    keys = iter(jax.random.split(rng, 4 + 6 * cfg.n_layers))
+    keys = iter(jax.random.split(rng, 2 + 4 * cfg.n_layers))
     params["embed/tok"] = norm(next(keys), (cfg.vocab, cfg.d_model), 0.02)
     params["embed/pos"] = norm(next(keys), (cfg.max_len, cfg.d_model), 0.02)
     for i in range(cfg.n_layers):

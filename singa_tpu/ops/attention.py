@@ -155,17 +155,26 @@ def flash_attention(
 ):
     """Flash attention: Pallas forward, reference-math backward.
 
-    Falls back to the dense reference when Pallas is unavailable or the
-    sequence does not tile evenly. ``interpret=True`` runs the kernel in
-    the Pallas interpreter (CPU testing); default auto-detects TPU.
+    Falls back to the dense reference when Pallas is unavailable, the
+    sequence does not tile evenly, or Sq != Sk. ``interpret=True`` runs
+    the kernel in the Pallas interpreter (CPU testing); default
+    auto-detects TPU.
+
+    NOTE: the backward pass recomputes through the dense reference, so
+    it materializes the S x S score matrix — training peak memory is the
+    dense peak. For long-context *training*, shard the sequence with
+    ring attention (singa_tpu/parallel/ring.py) instead; this kernel's
+    win is forward/inference memory and fusion.
     """
     return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
 
 
-def _use_kernel(q, block_q, block_k, interpret):
+def _use_kernel(q, k, block_q, block_k, interpret):
     if not HAS_PALLAS:
         return False
     s = q.shape[2]
+    if s != k.shape[2]:  # kernel assumes Sq == Sk; dense handles the rest
+        return False
     if s % block_q or s % block_k:
         return False
     if interpret is None:
@@ -174,7 +183,7 @@ def _use_kernel(q, block_q, block_k, interpret):
 
 
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
-    if not _use_kernel(q, block_q, block_k, interpret):
+    if not _use_kernel(q, k, block_q, block_k, interpret):
         return attention(q, k, v, causal=causal)
     b, h, s, d = q.shape
     bh = b * h

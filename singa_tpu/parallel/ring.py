@@ -58,14 +58,19 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool):
     nshards = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
-    out, m, l = block_attn_init(q)
+    in_dtype = q.dtype
+    # accumulate flash statistics in fp32 (matching the Pallas kernel's
+    # upcast) — bf16 exp-sums folded across many ring steps drift; K/V
+    # stay in the input dtype so ring traffic is not inflated
+    q32 = q.astype(jnp.float32)
+    out, m, l = block_attn_init(q32)
 
     def step(i, carry):
         out, m, l, k, v = carry
         # the K/V block visiting at step i originated on shard (my - i)
         src = (my - i) % nshards
         out, m, l = block_attn_update(
-            q, k, v, out, m, l,
+            q32, k.astype(jnp.float32), v.astype(jnp.float32), out, m, l,
             q_offset=my * s_local,
             k_offset=src * s_local,
             causal=causal,
@@ -80,7 +85,7 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool):
     out, m, l, k, v = jax.lax.fori_loop(
         0, nshards, step, (out, m, l, k, v)
     )
-    return block_attn_finish(out, m, l)
+    return block_attn_finish(out, m, l).astype(in_dtype)
 
 
 def ring_attention(

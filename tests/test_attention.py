@@ -4,6 +4,8 @@ training with each attention path. These are singa-tpu extensions — the
 reference is pre-transformer (SURVEY §5) — making long-context /
 sequence-parallel training first-class."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -52,6 +54,17 @@ class TestFlashKernel:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-4
             )
+
+    def test_cross_attention_lengths_fall_back(self):
+        """Sq != Sk (e.g. cross-attention / decode) must hit the dense
+        path, which supports it, instead of crashing in the kernel."""
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(1, 1, 128, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 1, 256, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 1, 256, 16).astype(np.float32))
+        ref = attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, True, 128, 128, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
 
     def test_uneven_seq_falls_back(self):
         q, k, v = qkv((1, 1, 100, 16))  # 100 % 128 != 0
@@ -114,6 +127,22 @@ class TestRingAttention:
         )(q, k, v)
         assert not out.sharding.is_fully_replicated
 
+    def test_bf16_accumulates_in_fp32(self):
+        """Ring statistics accumulate in fp32 like the Pallas kernel, so
+        bf16 inputs track the fp32 dense result to bf16 resolution."""
+        q, k, v = qkv((1, 2, 256, 32), seed=7)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        mesh = build_sp_mesh(1, 8)
+        got = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh, causal=True)
+        )(qb, kb, vb)
+        assert got.dtype == jnp.bfloat16
+        ref = attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(ref),
+            atol=0.02, rtol=0.02,
+        )
+
     def test_size_one_axis_short_circuits(self):
         q, k, v = qkv((1, 1, 64, 16))
         mesh = build_sp_mesh(1, 1, jax.devices()[:1])
@@ -138,22 +167,24 @@ def _toy_tokens(n, s, vocab, seed=0):
 
 
 class TestTransformerLM:
-    def _train(self, cfg, tokens, mesh=None, steps=60, lr=3e-3):
+    def _train(self, cfg, tokens, mesh=None, steps=60, lr=1e-2):
+        import optax
+
         params = init_lm(jax.random.PRNGKey(0), cfg)
+        opt = optax.adam(lr)
+        opt_state = opt.init(params)
 
         @jax.jit
-        def step(params):
+        def step(params, opt_state):
             loss, g = jax.value_and_grad(
                 lambda p: lm_loss(p, tokens, cfg, mesh)
             )(params)
-            return (
-                jax.tree.map(lambda p, g: p - lr * g, params, g),
-                loss,
-            )
+            updates, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
 
         loss0 = None
         for _ in range(steps):
-            params, loss = step(params)
+            params, opt_state, loss = step(params, opt_state)
             if loss0 is None:
                 loss0 = float(loss)
         return loss0, float(loss)
@@ -169,7 +200,7 @@ class TestTransformerLM:
         """Same params, same batch: ring-sharded loss == dense loss."""
         cfg_d = TransformerConfig(vocab=32, d_model=64, n_heads=2,
                                   n_layers=1, d_ff=128, max_len=64)
-        cfg_r = dataclasses_replace(cfg_d, attn="ring")
+        cfg_r = dataclasses.replace(cfg_d, attn="ring")
         tokens = _toy_tokens(4, 64, 32)
         params = init_lm(jax.random.PRNGKey(1), cfg_d)
         mesh = build_sp_mesh(1, 8)
@@ -186,9 +217,3 @@ class TestTransformerLM:
         mesh = build_sp_mesh(2, 4)
         loss0, loss1 = self._train(cfg, tokens, mesh=mesh, steps=60)
         assert loss1 < 0.3 * loss0, (loss0, loss1)
-
-
-def dataclasses_replace(cfg, **kw):
-    import dataclasses
-
-    return dataclasses.replace(cfg, **kw)
