@@ -97,6 +97,14 @@ class BatchPipeline:
         self._pos = int((self._pos + self.batchsize) % self.n)
         return idx
 
+    def next_indices(self) -> np.ndarray:
+        """Advance the stream and return the batch's record indices
+        without materializing arrays (device-cached datasets gather on
+        device). Do not mix with a running prefetch thread."""
+        if self._thread is not None:
+            raise RuntimeError("next_indices() after prefetch started")
+        return self._next_indices()
+
     def next_batch(self) -> tuple[np.ndarray, np.ndarray]:
         if self._prefetch:
             if self._queue is None:

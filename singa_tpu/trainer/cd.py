@@ -110,6 +110,7 @@ class CDTrainer(Trainer):
         if id(net) not in self._eval_steps:
 
             def eval_fn(params, batch):
+                batch = self._resolve_batch(net, batch)
                 metrics: dict = {}
 
                 def hook(layer, resolved, inputs, lrng):

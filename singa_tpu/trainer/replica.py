@@ -55,6 +55,10 @@ PROTOCOLS = ("Elastic", "RandomSync")
 class ReplicaTrainer(Trainer):
     """Trainer variant holding one param replica per data-axis mesh row."""
 
+    # the vmapped step expects a leading replica axis on every batch leaf;
+    # the shared device-cached dataset has none, so stay on the host path
+    _allow_device_cache = False
+
     def __init__(
         self,
         model_cfg: ModelConfig,
@@ -64,6 +68,7 @@ class ReplicaTrainer(Trainer):
         seed: int = 0,
         log: Callable[[str], None] = print,
         prefetch: bool | None = None,
+        device_cache: bool | None = None,  # accepted; replicas stay host-fed
     ):
         ucfg = model_cfg.updater
         if ucfg is None:
@@ -97,6 +102,7 @@ class ReplicaTrainer(Trainer):
             seed=seed,
             log=log,
             prefetch=prefetch,
+            device_cache=device_cache,
         )
         # each step consumes one batch per replica
         self._batch_size = self.train_net.batchsize * self.nreplicas

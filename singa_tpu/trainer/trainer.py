@@ -49,6 +49,10 @@ def _now(step: int, freq: int, after: int) -> bool:
 class Trainer:
     """Builds nets, owns params/updater state, runs the cadence loop."""
 
+    #: subclasses whose step shape is incompatible with on-device batch
+    #: gathering (e.g. the replica trainer's vmap) switch this off
+    _allow_device_cache = True
+
     def __init__(
         self,
         model_cfg: ModelConfig,
@@ -58,6 +62,7 @@ class Trainer:
         seed: int = 0,
         log: Callable[[str], None] = print,
         prefetch: bool | None = None,
+        device_cache: bool | None = None,
     ):
         self.cfg = model_cfg
         self.cluster = cluster_cfg
@@ -114,6 +119,15 @@ class Trainer:
                 for l in net.datalayers
             }
 
+        # --- device-resident dataset fast path ---
+        # When every data layer's decoded shard fits the budget, upload it
+        # once and gather batches *inside* the jitted step (host work per
+        # step drops to computing a batchsize-long index vector). The
+        # reference's per-step shard read + prefetch copy has no useful
+        # counterpart once the data already lives in HBM.
+        self._dev_data: dict[int, dict[str, dict]] = {}
+        self._cached = self._maybe_cache_datasets(device_cache)
+
         if model_cfg.checkpoint_frequency and self._checkpoint_dir() is None:
             self.log(
                 "WARNING: checkpoint_frequency is set but no cluster "
@@ -122,7 +136,9 @@ class Trainer:
             )
 
         # --- the one compiled program ---
-        self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
+        self._train_step = jax.jit(
+            self._train_step_entry, donate_argnums=(0, 1)
+        )
         self._eval_steps: dict[int, Callable] = {}
         self._batch_size = self.train_net.batchsize
 
@@ -156,8 +172,71 @@ class Trainer:
         }
 
     # ------------------------------------------------------------------
+    # device-resident dataset cache
+    # ------------------------------------------------------------------
+
+    def _maybe_cache_datasets(self, enabled: bool | None) -> bool:
+        """Upload every net's dataset to the mesh (replicated) when it
+        fits SINGA_TPU_DEVICE_CACHE_MB (default 512). Explicit
+        ``device_cache=False`` or a cache-incompatible subclass wins."""
+        if not self._allow_device_cache or enabled is False:
+            return False
+        nets = [n for n in (self.train_net, self.test_net, self.val_net)
+                if n is not None]
+        total = sum(
+            l.images.nbytes + l.labels.nbytes
+            for net in nets for l in net.datalayers
+        )
+        if enabled is None:
+            limit = float(os.environ.get("SINGA_TPU_DEVICE_CACHE_MB", "512"))
+            if total > limit * 1e6:
+                return False
+        if total == 0:
+            return False
+        for net in nets:
+            self._dev_data[id(net)] = {
+                l.name: {
+                    "image": jax.device_put(
+                        jnp.asarray(l.images), self._repl
+                    ),
+                    "label": jax.device_put(
+                        jnp.asarray(l.labels), self._repl
+                    ),
+                }
+                for l in net.datalayers
+            }
+        return True
+
+    def _resolve_batch(self, net: Net, batch: dict, constrain: bool = True):
+        """Turn ``__idx__``-tagged feeds (device-cached mode) into real
+        per-batch arrays by gathering on device; host-assembled feeds pass
+        through unchanged. Runs inside the jitted step, so the gather and
+        everything downstream compile into one program."""
+        out = {}
+        for name, feed in batch.items():
+            if "__idx__" not in feed:
+                out[name] = feed
+                continue
+            idx = feed["__idx__"]
+            img = jnp.take(feed["image"], idx, axis=0)
+            lbl = jnp.take(feed["label"], idx, axis=0)
+            if constrain and net is self.train_net:
+                sh = self.batch_sh.get(name)
+                if sh is not None:
+                    img = jax.lax.with_sharding_constraint(img, sh["image"])
+                    lbl = jax.lax.with_sharding_constraint(lbl, sh["label"])
+            out[name] = {"image": img, "label": lbl}
+        return out
+
+    # ------------------------------------------------------------------
     # compiled step functions
     # ------------------------------------------------------------------
+
+    def _train_step_entry(self, params, state, step, batch, rng):
+        """Jit entry: resolve cached batches, then run the (possibly
+        subclass-overridden) step body."""
+        batch = self._resolve_batch(self.train_net, batch)
+        return self._train_step_fn(params, state, step, batch, rng)
 
     def _train_step_fn(self, params, state, step, batch, rng):
         def loss_fn(p):
@@ -176,6 +255,7 @@ class Trainer:
         if id(net) not in self._eval_steps:
 
             def eval_fn(params, batch):
+                batch = self._resolve_batch(net, batch)
                 _, metrics = net.forward(params, batch, training=False)
                 return metrics
 
@@ -189,6 +269,13 @@ class Trainer:
     def _next_batch(self, net: Net) -> dict:
         """Assemble + shard one batch dict for ``net``'s data layers."""
         out = {}
+        if self._cached:
+            for name, pipe in self._pipelines[id(net)].items():
+                d = self._dev_data[id(net)][name]
+                out[name] = {
+                    "__idx__": jnp.asarray(pipe.next_indices()), **d
+                }
+            return out
         for name, pipe in self._pipelines[id(net)].items():
             images, labels = pipe.next_batch()
             sh = self.batch_sh.get(name)
@@ -308,7 +395,9 @@ class Trainer:
         reference's debug dump (worker.cc:262-265, neuralnet.cc:350-378).
         Reuses the step's own batch — debug mode must not consume extra
         training data or shift the stream position."""
-        batch = self._last_batch
+        batch = self._resolve_batch(
+            self.train_net, self._last_batch, constrain=False
+        )
         rng = jax.random.fold_in(self._step_key, step)
         _, _, acts = self.train_net.forward(
             self.params, batch, training=True, rng=rng, return_acts=True

@@ -284,3 +284,29 @@ def test_lenet_conv_conf_trains_digits(tmp_path):
     assert trainer.specs["conv1/bias"].lr_mult == 2.0
     trainer.run()
     assert final_test_accuracy(trainer) >= 0.93
+
+
+def test_device_cache_matches_host_path(tmp_path):
+    """The device-resident dataset fast path must be a pure optimization:
+    identical batch stream, identical loss/precision trajectory."""
+    runs = {}
+    for cached in (True, False):
+        cfg = make_conf(
+            tmp_path / ("c" if cached else "h"),
+            synthetic_arrays(300, seed=3),
+            synthetic_arrays(128, seed=3, noise_seed=4),
+            train_steps=12,
+        )
+        trainer = Trainer(
+            cfg, seed=0, log=lambda s: None, prefetch=False,
+            device_cache=cached,
+        )
+        assert trainer._cached is cached
+        losses = []
+        for step in range(cfg.train_steps):
+            trainer.train_one_batch(step)
+            losses.append(float(next(iter(trainer.perf.avg().values()))["loss"]))
+            trainer.perf.reset()
+        runs[cached] = (losses, final_test_accuracy(trainer))
+    np.testing.assert_allclose(runs[True][0], runs[False][0], rtol=2e-5)
+    np.testing.assert_allclose(runs[True][1], runs[False][1], rtol=2e-5)
