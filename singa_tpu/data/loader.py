@@ -197,6 +197,59 @@ def split_shard(input_dir: str, prefix: str, n: int, mode: str = "equal"):
                 w.flush()
 
 
+# ------------------- LMDB interop (reference kLMDBData) -------------------
+
+
+def shard_to_lmdb(input_dir: str, output_dir: str) -> int:
+    """Re-encode a shard as a Caffe-style LMDB of Datum messages, keyed
+    like Caffe's convert tools (%08d). Lets kLMDBData configs run against
+    data produced by this loader."""
+    from .lmdbio import LMDBError, write_lmdb
+    from .records import Datum, decode_record, encode_datum
+
+    def datums():
+        with ShardReader(input_dir) as reader:
+            for key, val in reader:
+                rec = decode_record(val)
+                shape = list(rec.shape) + [1] * (3 - len(rec.shape))
+                if len(rec.shape) == 2:  # (H,W) grayscale -> C=1
+                    shape = [1, rec.shape[0], rec.shape[1]]
+                d = Datum(
+                    channels=shape[0], height=shape[1], width=shape[2],
+                    data=rec.pixel, label=rec.label, float_data=rec.data,
+                )
+                # latin-1 mirrors lmdb_to_shard's decode: keys are raw bytes
+                yield (key.encode("latin-1") if isinstance(key, str)
+                       else key, encode_datum(d))
+
+    try:
+        # loader-written shards insert zero-padded ascending keys, so the
+        # streaming O(page)-memory path normally wins
+        return write_lmdb(output_dir, datums(), assume_sorted=True)
+    except LMDBError as e:
+        if "out of order" not in str(e):
+            raise
+        return write_lmdb(output_dir, datums())
+
+
+def lmdb_to_shard(input_dir: str, output_dir: str) -> int:
+    """Convert a Caffe LMDB into a shard (the migration path the old
+    kLMDBData error message promised)."""
+    from .lmdbio import LMDBReader
+    from .records import datum_to_image_record, decode_datum, encode_record
+
+    n = 0
+    with LMDBReader(input_dir) as reader, ShardWriter(
+        output_dir, append=True
+    ) as w:
+        for key, val in reader:
+            rec = datum_to_image_record(decode_datum(val))
+            if w.insert(key.decode("latin-1"), encode_record(rec)):
+                n += 1
+        w.flush()
+    return n
+
+
 # ---------------------------- CLI ----------------------------
 
 
@@ -229,6 +282,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--input", required=True)
     p.add_argument("--output", required=True)
 
+    p = sub.add_parser("shard2lmdb")
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+
+    p = sub.add_parser("lmdb2shard")
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+
     p = sub.add_parser("split")
     p.add_argument("--input", required=True)
     p.add_argument("--prefix", required=True)
@@ -254,6 +315,12 @@ def main(argv: list[str] | None = None) -> int:
                 channels=args.channels,
             ),
         )
+    elif args.source == "shard2lmdb":
+        n = shard_to_lmdb(args.input, args.output)
+        print(f"wrote {n} datums into {os.path.join(args.output, 'data.mdb')}")
+        return 0
+    elif args.source == "lmdb2shard":
+        n = lmdb_to_shard(args.input, args.output)
     elif args.source == "compute-mean":
         mean = compute_mean(args.input, args.output)
         print(f"mean {tuple(mean.shape)} -> {args.output}")

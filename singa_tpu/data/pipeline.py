@@ -58,6 +58,32 @@ def load_shard_arrays(folder: str) -> tuple[np.ndarray, np.ndarray]:
     return np.stack(images), np.asarray(labels, dtype=np.int32)
 
 
+def load_lmdb_arrays(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """Decode every Datum in a Caffe LMDB into (images, labels) arrays,
+    the in-memory equivalent of LMDBDataLayer's cursor loop + conversion
+    (reference layer.cc:237-328)."""
+    from .lmdbio import LMDBReader
+    from .records import datum_to_image_record, decode_datum
+
+    images: list[np.ndarray] = []
+    labels: list[int] = []
+    with LMDBReader(path) as reader:
+        for _, val in reader:
+            rec = datum_to_image_record(decode_datum(val))
+            shape = tuple(rec.shape) if any(rec.shape) else (-1,)
+            if rec.pixel:
+                img = np.frombuffer(rec.pixel, dtype=np.uint8).astype(
+                    np.float32
+                )
+            else:
+                img = np.asarray(rec.data, dtype=np.float32)
+            images.append(img.reshape(shape))
+            labels.append(rec.label)
+    if not images:
+        raise ValueError(f"LMDB {path!r} holds no records")
+    return np.stack(images), np.asarray(labels, dtype=np.int32)
+
+
 class BatchPipeline:
     """Batched sequential iteration with wraparound and prefetch.
 

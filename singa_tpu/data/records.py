@@ -168,6 +168,97 @@ def _decode_image(buf: bytes) -> ImageRecord:
     return rec
 
 
+@dataclasses.dataclass
+class Datum:
+    """Caffe's LMDB record message (the reference converts it to a
+    SingleLabelImageRecord in LMDBDataLayer, layer.cc:306-328):
+
+        message Datum { optional int32 channels=1; optional int32 height=2;
+          optional int32 width=3; optional bytes data=4; optional int32
+          label=5; repeated float float_data=6; optional bool encoded=7; }
+    """
+
+    channels: int = 0
+    height: int = 0
+    width: int = 0
+    data: bytes = b""
+    label: int = 0
+    float_data: list[float] = dataclasses.field(default_factory=list)
+    encoded: bool = False
+
+
+def encode_datum(d: Datum) -> bytes:
+    out = bytearray()
+    for field, v in ((1, d.channels), (2, d.height), (3, d.width)):
+        out.append(field << 3)
+        _write_varint(out, v)
+    if d.data:
+        out.append(0x22)  # field 4, bytes
+        _write_varint(out, len(d.data))
+        out.extend(d.data)
+    out.append(0x28)  # field 5, varint
+    _write_varint(out, d.label)
+    for f in d.float_data:
+        out.append(0x35)  # field 6, fixed32
+        out.extend(struct.pack("<f", f))
+    if d.encoded:
+        out.extend((0x38, 1))
+    return bytes(out)
+
+
+def decode_datum(buf: bytes) -> Datum:
+    d = Datum()
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field in (1, 2, 3, 5, 7) and wt == 0:
+            v, pos = _read_varint(buf, pos)
+            v = _int32(v)
+            if field == 1:
+                d.channels = v
+            elif field == 2:
+                d.height = v
+            elif field == 3:
+                d.width = v
+            elif field == 5:
+                d.label = v
+            else:
+                d.encoded = bool(v)
+        elif field == 4 and wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            d.data = buf[pos : pos + ln]
+            pos += ln
+        elif field == 6 and wt == 5:
+            d.float_data.append(struct.unpack_from("<f", buf, pos)[0])
+            pos += 4
+        elif field == 6 and wt == 2:  # packed repeated float
+            ln, pos = _read_varint(buf, pos)
+            if ln % 4:
+                raise RecordError("bad packed float length")
+            d.float_data.extend(struct.unpack_from(f"<{ln // 4}f", buf, pos))
+            pos += ln
+        else:
+            pos = _skip_field(buf, pos, wt)
+    return d
+
+
+def datum_to_image_record(d: Datum) -> ImageRecord:
+    """The reference's Datum -> SingleLabelImageRecord conversion
+    (layer.cc:306-328): shape=(C,H,W); raw uint8 ``data`` xor float_data."""
+    if d.encoded:
+        raise RecordError(
+            "encoded (compressed) Datum payloads are unsupported; "
+            "re-export the database with raw pixels"
+        )
+    return ImageRecord(
+        shape=[d.channels, d.height, d.width],
+        label=d.label,
+        pixel=d.data,
+        data=list(d.float_data),
+    )
+
+
 def decode_record(buf: bytes) -> ImageRecord:
     """Parse a serialized Record; returns its SingleLabelImageRecord."""
     rtype = RECORD_TYPE_SINGLE_LABEL_IMAGE

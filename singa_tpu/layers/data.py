@@ -23,11 +23,13 @@ from ..data.pipeline import load_shard_arrays
 from .base import Layer, Shape
 
 
-class ShardDataLayer(Layer):
-    """kShardData (reference: layer.cc:646-673)."""
+class _ArrayDataLayer(Layer):
+    """Shared data-layer shape: open the source at build time to learn the
+    sample shape (ShardDataLayer::Setup reads one record the same way,
+    layer.cc:662-672), hold the decoded arrays, forward the fed batch."""
 
-    TYPE = "kShardData"
     is_datalayer = True
+    LOADER: staticmethod  # (path) -> (images, labels)
 
     def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
         p = self.cfg.data_param
@@ -38,9 +40,8 @@ class ShardDataLayer(Layer):
         self.path = p.path
         self.batchsize = p.batchsize
         self.random_skip = p.random_skip
-        images, labels = load_shard_arrays(self.path)
-        self.images, self.labels = images, labels
-        self.sample_shape = tuple(images.shape[1:])
+        self.images, self.labels = type(self).LOADER(self.path)
+        self.sample_shape = tuple(self.images.shape[1:])
         return (self.batchsize, *self.sample_shape)
 
     def apply(self, params, inputs, *, training, rng=None):
@@ -48,24 +49,28 @@ class ShardDataLayer(Layer):
         return inputs[0]
 
 
-class LMDBDataLayer(Layer):
-    """kLMDBData (reference: layer.cc:237-328) — config-compatible gate.
+class ShardDataLayer(_ArrayDataLayer):
+    """kShardData (reference: layer.cc:646-673)."""
 
-    The reference reads Caffe LMDB databases; this environment ships no
-    lmdb binding, so the layer exists to give a precise, actionable error:
-    convert the LMDB to a shard with the loader CLI and switch the layer
-    type. The *config* still parses unchanged.
-    """
+    TYPE = "kShardData"
+    LOADER = staticmethod(load_shard_arrays)
+
+
+class LMDBDataLayer(_ArrayDataLayer):
+    """kLMDBData (reference: layer.cc:237-328): reads a Caffe LMDB through
+    the pure-Python B+tree reader (singa_tpu/data/lmdbio.py — no liblmdb
+    in this image), converting each Datum to the record layout
+    (datum_to_image_record = the reference's ConvertDatumToSingleLabel
+    ImageRecord, layer.cc:306-328). Cursor wraparound becomes the batch
+    pipeline's modular indexing."""
 
     TYPE = "kLMDBData"
-    is_datalayer = True
 
-    def setup(self, src_shapes, batchsize):
-        raise ConfigError(
-            f"layer {self.name!r}: kLMDBData requires an LMDB binding that "
-            "is not available here; convert the database to a shard "
-            "(python -m singa_tpu.data.loader) and use kShardData"
-        )
+    @staticmethod
+    def LOADER(path):
+        from ..data.pipeline import load_lmdb_arrays
+
+        return load_lmdb_arrays(path)
 
 
 class MnistImageLayer(Layer):

@@ -1,0 +1,210 @@
+"""LMDB codec + kLMDBData layer tests.
+
+The writer/reader pair is validated structurally (meta/branch/overflow page
+layout) by round-tripping datasets sized to force each page type, matching
+the reference's LMDBDataLayer ingestion path (layer.cc:237-328)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu.data.lmdbio import (
+    LMDBError,
+    LMDBReader,
+    P_INVALID,
+    write_lmdb,
+)
+from singa_tpu.data.loader import (
+    lmdb_to_shard,
+    shard_to_lmdb,
+    synthetic_arrays,
+    write_records,
+)
+from singa_tpu.data.pipeline import load_lmdb_arrays, load_shard_arrays
+from singa_tpu.data.records import (
+    Datum,
+    datum_to_image_record,
+    decode_datum,
+    encode_datum,
+)
+
+
+def _roundtrip(tmp_path, items):
+    db = str(tmp_path / "db")
+    n = write_lmdb(db, items)
+    with LMDBReader(db) as r:
+        got = list(r)
+        assert r.entries == n
+    assert got == sorted(items, key=lambda kv: kv[0])
+    return got
+
+
+def test_small_values_single_leaf(tmp_path):
+    items = [(f"{i:08d}".encode(), bytes([i]) * 10) for i in range(5)]
+    _roundtrip(tmp_path, items)
+
+
+def test_unsorted_input_is_sorted_by_key(tmp_path):
+    items = [(b"b", b"2"), (b"a", b"1"), (b"c", b"3")]
+    got = _roundtrip(tmp_path, items)
+    assert [k for k, _ in got] == [b"a", b"b", b"c"]
+
+
+def test_overflow_values(tmp_path):
+    # each value ~3KB > nodemax (2040 for 4K pages) -> overflow chains
+    items = [
+        (f"{i:08d}".encode(), bytes(range(256)) * 12 + bytes([i]))
+        for i in range(7)
+    ]
+    _roundtrip(tmp_path, items)
+
+
+def test_multi_leaf_and_branch_pages(tmp_path):
+    # ~2000 small records: dozens of leaves under at least one branch level
+    items = [
+        (f"{i:08d}".encode(), (f"value-{i}" * 5).encode()) for i in range(2000)
+    ]
+    _roundtrip(tmp_path, items)
+
+
+def test_deep_tree_two_branch_levels(tmp_path):
+    # fat keys shrink fan-out; 40k records forces depth >= 3
+    items = [
+        (f"key-{i:012d}-{'x' * 80}".encode(), f"{i}".encode())
+        for i in range(40_000)
+    ]
+    db = str(tmp_path / "db")
+    write_lmdb(db, items)
+    with LMDBReader(db) as r:
+        assert r.meta.depth >= 3
+        assert list(r) == items
+
+
+def test_empty_db(tmp_path):
+    db = str(tmp_path / "db")
+    write_lmdb(db, [])
+    with LMDBReader(db) as r:
+        assert r.meta.root == P_INVALID
+        assert list(r) == []
+
+
+def test_nonstandard_page_size(tmp_path):
+    """Readers must take the page size from the meta, not assume 4096
+    (liblmdb uses the OS page size — 16K on some hosts)."""
+    items = [(f"{i:04d}".encode(), bytes([i % 251]) * 3000) for i in range(50)]
+    db = str(tmp_path / "db")
+    write_lmdb(db, items, psize=16384)
+    with LMDBReader(db) as r:
+        assert r.psize == 16384
+        assert list(r) == items
+
+
+def test_torn_meta0_recovers_via_meta1(tmp_path):
+    items = [(b"k%d" % i, b"v%d" % i) for i in range(9)]
+    db = str(tmp_path / "db")
+    write_lmdb(db, sorted(items))
+    data = tmp_path / "db" / "data.mdb"
+    raw = bytearray(data.read_bytes())
+    raw[:4096] = b"\x00" * 4096  # torn first meta
+    data.write_bytes(bytes(raw))
+    with LMDBReader(str(db)) as r:
+        assert list(r) == sorted(items)
+
+
+def test_assume_sorted_rejects_out_of_order(tmp_path):
+    with pytest.raises(LMDBError, match="out of order"):
+        write_lmdb(
+            str(tmp_path / "db"),
+            [(b"b", b"2"), (b"a", b"1")],
+            assume_sorted=True,
+        )
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    with pytest.raises(LMDBError, match="duplicate"):
+        write_lmdb(str(tmp_path / "db"), [(b"k", b"1"), (b"k", b"2")])
+
+
+def test_garbage_file_rejected(tmp_path):
+    p = tmp_path / "junk"
+    p.write_bytes(b"\x00" * 16384)
+    with pytest.raises(LMDBError):
+        LMDBReader(str(p))
+
+
+def test_datum_codec_roundtrip():
+    d = Datum(
+        channels=3, height=4, width=5, data=bytes(range(60)), label=7
+    )
+    got = decode_datum(encode_datum(d))
+    assert got == d
+    rec = datum_to_image_record(got)
+    assert rec.shape == [3, 4, 5]
+    assert rec.label == 7
+    assert rec.pixel == d.data
+
+
+def test_datum_float_data_roundtrip():
+    d = Datum(channels=1, height=1, width=3, float_data=[0.5, -1.25, 3.0])
+    got = decode_datum(encode_datum(d))
+    assert got.float_data == [0.5, -1.25, 3.0]
+
+
+def test_shard_lmdb_shard_roundtrip(tmp_path):
+    images, labels = synthetic_arrays(64, seed=3)
+    shard = str(tmp_path / "shard")
+    write_records(shard, images, labels)
+    db = str(tmp_path / "db")
+    assert shard_to_lmdb(shard, db) == 64
+
+    limg, llab = load_lmdb_arrays(db)
+    # grayscale (H,W) records gain the C=1 datum dim
+    np.testing.assert_array_equal(limg.reshape(64, 28, 28), images)
+    np.testing.assert_array_equal(llab, labels)
+
+    back = str(tmp_path / "back")
+    assert lmdb_to_shard(db, back) == 64
+    bimg, blab = load_shard_arrays(back)
+    np.testing.assert_array_equal(
+        bimg.reshape(64, 28, 28), images.astype(np.float32)
+    )
+    np.testing.assert_array_equal(blab, labels)
+
+
+def test_lmdb_data_layer_trains(tmp_path):
+    """A kLMDBData job config trains end-to-end off a real LMDB."""
+    from singa_tpu.config import parse_model_config
+    from singa_tpu.trainer import Trainer
+
+    images, labels = synthetic_arrays(96, classes=4, seed=1)
+    shard = str(tmp_path / "shard")
+    write_records(shard, images, labels)
+    db = str(tmp_path / "db")
+    shard_to_lmdb(shard, db)
+
+    conf = f"""
+name: "lmdb-smoke"
+train_steps: 12
+updater {{ base_learning_rate: 0.05 param_type: "Param" }}
+neuralnet {{
+  layer {{ name: "data" type: "kLMDBData"
+          data_param {{ path: "{db}" batchsize: 32 }} }}
+  layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+          mnist_param {{ norm_a: 255 norm_b: 0 }} }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{ name: "fc" type: "kInnerProduct" srclayers: "mnist"
+          inner_product_param {{ num_output: 4 }}
+          param {{ name: "w" init_method: "kUniformSqrtFanIn" }}
+          param {{ name: "b" init_method: "kConstant" value: 0 }} }}
+  layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "fc" srclayers: "label"
+          softmaxloss_param {{ topk: 1 }} }}
+}}
+"""
+    cfg = parse_model_config(conf)
+    tr = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
+    losses = []
+    for step in range(cfg.train_steps):
+        tr.train_one_batch(step)
+        (m,) = tr.perf.avg().values()
+        losses.append(m["loss"])
+        tr.perf.reset()
+    assert losses[-1] < losses[0]  # it learns
