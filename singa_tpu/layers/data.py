@@ -75,8 +75,14 @@ class LMDBDataLayer(_ArrayDataLayer):
 
 class MnistImageLayer(Layer):
     """kMnistImage (reference: layer.cc:381-473): uint8 pixels ->
-    float (x / norm_a) - norm_b. The reference's elastic-distortion pipeline
-    is commented out there (layer.cc:410-440) and therefore not ported."""
+    float (x / norm_a) - norm_b, plus the elastic-distortion pipeline the
+    reference configures but ships commented out (layer.cc:408-440):
+    kernel/sigma/alpha Gaussian displacement fields, beta rotation/shear,
+    gamma rescale — implemented for real in singa_tpu/ops/distortion.py
+    and applied train-side inside the jitted step. ``resize`` bilinearly
+    resizes (the reference's live code top-left-crops to ``resize``
+    instead, layer.cc:441-448 — a bug its disabled warpAffine would have
+    fixed; we implement the intended behavior)."""
 
     TYPE = "kMnistImage"
     is_parserlayer = True
@@ -85,22 +91,37 @@ class MnistImageLayer(Layer):
         p = self.cfg.mnist_param
         self.norm_a = p.norm_a if p else 1.0
         self.norm_b = p.norm_b if p else 0.0
+        self.kernel = p.kernel if p else 0
+        self.sigma = p.sigma if p else 0.0
+        self.alpha = p.alpha if p else 0.0
+        self.beta = p.beta if p else 0.0
+        self.gamma = p.gamma if p else 0.0
         src = src_shapes[0]  # the data layer's (batch, H, W)
         if len(src) < 3:
             raise ConfigError(f"layer {self.name!r}: expects image records")
         size = src[-1]
         if src[-2] != size:
             raise ConfigError(f"layer {self.name!r}: MNIST images must be square")
-        resize = p.resize if p else 0
-        if resize and resize != size:
-            raise ConfigError(
-                f"layer {self.name!r}: resize={resize} unsupported (records "
-                f"are {size}x{size}); resize at loader time instead"
-            )
-        return (src[0], size, size)
+        self.resize = (p.resize if p else 0) or size
+        return (src[0], self.resize, self.resize)
 
     def apply(self, params, inputs, *, training, rng=None):
+        import jax
+
         x = inputs[0]["image"].astype(jnp.float32)
+        if self.resize != x.shape[-1]:
+            x = jax.image.resize(
+                x, (*x.shape[:-2], self.resize, self.resize), "linear"
+            )
+        distorting = (self.alpha and self.kernel) or self.beta or self.gamma
+        if training and rng is not None and distorting:
+            from ..ops.distortion import distort
+
+            x = distort(
+                x, jax.random.fold_in(rng, 23),
+                kernel=self.kernel, sigma=self.sigma, alpha=self.alpha,
+                beta=self.beta, gamma=self.gamma,
+            )
         return x / self.norm_a - self.norm_b
 
 

@@ -4,10 +4,10 @@ Mirrors the reference binary's gflags surface (src/main.cc:13-18:
 -procsID, -hostfile, -cluster_conf, -model_conf) so reference job launch
 lines work unchanged. The worker/server role dispatch (main.cc:49-55)
 disappears: there is no parameter-server tier — every process is a trainer
-and grad sync is an XLA collective. -procsID/-hostfile are accepted and
-ignored for that reason (multi-host initialization is
-jax.distributed.initialize's job, driven by the TPU runtime's own
-environment, not a hostfile).
+and grad sync is an XLA collective. -procsID/-hostfile feed
+jax.distributed.initialize (parallel/launch.py) when a multi-host run is
+launched reference-style; on TPU pods the runtime's own environment
+drives the rendezvous and both flags may be omitted.
 """
 
 from __future__ import annotations
@@ -25,14 +25,18 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     )
     ap.add_argument("-model_conf", required=True, help="ModelProto text file")
     ap.add_argument("-cluster_conf", default=None, help="ClusterProto text file")
-    ap.add_argument("-procsID", type=int, default=0, help="accepted; unused")
-    ap.add_argument("-hostfile", default=None, help="accepted; unused")
+    ap.add_argument("-procsID", type=int, default=0, help="process rank")
+    ap.add_argument("-hostfile", default=None,
+                    help="one host per line; line 0 hosts the rendezvous")
     ap.add_argument("-seed", type=int, default=0, help="init/dropout RNG seed")
     return ap.parse_args(argv)
 
 
 def main(argv: list[str] | None = None) -> int:
+    from .parallel import init_distributed
+
     args = parse_args(argv)
+    init_distributed(args.procsID, args.hostfile)
     model_cfg = load_model_config(args.model_conf)
     cluster_cfg = (
         load_cluster_config(args.cluster_conf) if args.cluster_conf else None

@@ -1,0 +1,140 @@
+"""Train-time image distortion: elastic deformation + random affine.
+
+The reference's MnistImageLayer carries config knobs for the classic
+Simard elastic-distortion pipeline — kernel/sigma/alpha (Gaussian-smoothed
+random displacement fields), beta (rotation/shear degrees), gamma
+(rescale percent), elastic_freq — but ships the implementation commented
+out (src/worker/layer.cc:408-440; fields read at :455-463). This module
+implements the pipeline for real, as batched JAX ops that run inside the
+jitted train step.
+
+Design notes vs the disabled reference code:
+- the whole batch distorts in one fused program (vmap over per-sample
+  displacement fields + affine matrices) instead of per-record OpenCV
+  calls on the prefetch thread;
+- the reference halves the shear for labels 1 and 7 (a hand-tuned MNIST
+  hack in dead code); that label coupling is not reproduced;
+- sampling is bilinear with zero padding outside the canvas, matching
+  cv::warpAffine's default border handling closely enough for training.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def gaussian_kernel1d(kernel: int, sigma: float) -> jnp.ndarray:
+    """Odd-length normalized Gaussian taps."""
+    if kernel % 2 == 0:
+        kernel += 1
+    x = jnp.arange(kernel, dtype=jnp.float32) - kernel // 2
+    k = jnp.exp(-0.5 * (x / max(sigma, 1e-6)) ** 2)
+    return k / jnp.sum(k)
+
+
+def _smooth(field: jnp.ndarray, taps: jnp.ndarray) -> jnp.ndarray:
+    """Separable Gaussian blur of a (B,H,W) field (reflect padding)."""
+    pad = taps.shape[0] // 2
+
+    def conv1d(x):  # along the last axis
+        xp = jnp.pad(x, ((0, 0), (0, 0), (pad, pad)), mode="reflect")
+        return jax.vmap(
+            lambda row: jnp.convolve(row, taps, mode="valid"),
+        )(xp.reshape(-1, xp.shape[-1])).reshape(x.shape)
+
+    field = conv1d(field)
+    field = conv1d(field.swapaxes(-1, -2)).swapaxes(-1, -2)
+    return field
+
+
+def elastic_offsets(
+    rng: jax.Array, shape: tuple[int, int, int], kernel: int, sigma: float,
+    alpha: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-pixel (dy, dx) displacement fields: uniform noise in [-1,1]
+    blurred by a (kernel, sigma) Gaussian and scaled by alpha — Simard's
+    elastic distortion, the op the reference's kernel_/sigma_/alpha_
+    fields configure."""
+    taps = gaussian_kernel1d(kernel, sigma)
+    ky, kx = jax.random.split(rng)
+    dy = _smooth(jax.random.uniform(ky, shape, minval=-1.0, maxval=1.0), taps)
+    dx = _smooth(jax.random.uniform(kx, shape, minval=-1.0, maxval=1.0), taps)
+    return dy * alpha, dx * alpha
+
+
+def affine_matrices(
+    rng: jax.Array, n: int, beta: float, gamma: float
+) -> jnp.ndarray:
+    """(n,2,2) random affine maps: rescale both axes by ±gamma percent,
+    then either rotate by ±beta degrees or shear by ±beta/90 (coin flip
+    per sample) — the reference's gamma_/beta_ semantics."""
+    r = jax.random.uniform(rng, (n, 4), minval=-1.0, maxval=1.0)
+    coin = jax.random.bernoulli(jax.random.fold_in(rng, 1), 0.5, (n,))
+    sy = 1.0 + r[:, 0] * gamma / 100.0
+    sx = 1.0 + r[:, 1] * gamma / 100.0
+    theta = r[:, 2] * beta * math.pi / 180.0
+    cos, sin = jnp.cos(theta), jnp.sin(theta)
+    rot = jnp.stack(
+        [jnp.stack([cos, -sin], -1), jnp.stack([sin, cos], -1)], -2
+    )
+    shear = r[:, 3] * beta / 90.0
+    ones, zeros = jnp.ones_like(shear), jnp.zeros_like(shear)
+    shr = jnp.stack(
+        [jnp.stack([ones, shear], -1), jnp.stack([zeros, ones], -1)], -2
+    )
+    warp = jnp.where(coin[:, None, None], rot, shr)
+    scale = jnp.stack(
+        [jnp.stack([sy, zeros], -1), jnp.stack([zeros, sx], -1)], -2
+    )
+    return warp @ scale
+
+
+def distort(
+    images: jnp.ndarray,
+    rng: jax.Array,
+    *,
+    kernel: int = 0,
+    sigma: float = 0.0,
+    alpha: float = 0.0,
+    beta: float = 0.0,
+    gamma: float = 0.0,
+) -> jnp.ndarray:
+    """Apply elastic + affine distortion to a (B,H,W) image batch.
+
+    Coordinates warp around the image center; sampling is bilinear with
+    zero fill. Knobs at zero disable their stage, so any subset of
+    {elastic, rotation/shear, rescale} composes.
+    """
+    b, h, w = images.shape
+    yy, xx = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.float32),
+        jnp.arange(w, dtype=jnp.float32),
+        indexing="ij",
+    )
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ry, rx = jax.random.split(jax.random.fold_in(rng, 17))
+
+    if beta or gamma:
+        mats = affine_matrices(ry, b, beta, gamma)
+        rel = jnp.stack([yy - cy, xx - cx])  # (2,H,W)
+        src = jnp.einsum("nij,jhw->nihw", mats, rel)
+        sy = src[:, 0] + cy
+        sx = src[:, 1] + cx
+    else:
+        sy = jnp.broadcast_to(yy, (b, h, w))
+        sx = jnp.broadcast_to(xx, (b, h, w))
+
+    if alpha and kernel:
+        dy, dx = elastic_offsets(rx, (b, h, w), kernel, sigma, alpha)
+        sy = sy + dy
+        sx = sx + dx
+
+    def sample(img, y, x):
+        return jax.scipy.ndimage.map_coordinates(
+            img, [y, x], order=1, mode="constant", cval=0.0
+        )
+
+    return jax.vmap(sample)(images, sy, sx)

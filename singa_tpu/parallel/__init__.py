@@ -17,6 +17,7 @@ from .consistency import (
     sync_now,
     sync_ratio,
 )
+from .launch import coordinator_address, init_distributed, read_hostfile
 from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, mesh_from_cluster
 from .shardings import (
     batch_shardings,
@@ -30,6 +31,9 @@ __all__ = [
     "MODEL_AXIS",
     "build_mesh",
     "mesh_from_cluster",
+    "coordinator_address",
+    "init_distributed",
+    "read_hostfile",
     "batch_shardings",
     "param_shardings",
     "replicated",

@@ -1,0 +1,103 @@
+"""Multi-host bootstrap: the reference's process-identity machinery on JAX.
+
+The reference assigns roles from ``-procsID`` + a hostfile (one address
+per line, comments allowed; src/utils/cluster.cc:18-24) and then
+hand-shakes every process through Router PING/PONG barriers
+(src/utils/router.cc:16-86). On TPU both jobs belong to
+``jax.distributed.initialize``: the coordinator (hostfile line 0) runs
+the rendezvous service, every process reports its rank, and the runtime
+wires the global device mesh — after which cross-host traffic is XLA
+collectives over ICI/DCN, not sockets we manage.
+
+On TPU pods (GKE / gcloud-created slices) the runtime injects its own
+coordinator environment and ``initialize()`` needs no arguments; the
+hostfile path exists for parity with reference launch scripts and for
+CPU/GPU clusters.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+DEFAULT_PORT = 9999  # arbitrary; the reference's start_port plays this role
+
+
+def read_hostfile(path: str) -> list[str]:
+    """Hostfile -> ordered address list (cluster.cc:18-24 semantics:
+    one host per line, blank lines and #-comments skipped, order is
+    process rank order)."""
+    hosts: list[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                hosts.append(line)
+    return hosts
+
+
+def coordinator_address(hosts: list[str], port: int = DEFAULT_PORT) -> str:
+    """Line 0 hosts the rendezvous, like the reference's server-0 router
+    bind (router.cc:46-86). A host may carry its own ``:port``."""
+    if not hosts:
+        raise ValueError("empty hostfile")
+    head = hosts[0]
+    return head if ":" in head else f"{head}:{port}"
+
+
+def init_distributed(
+    procs_id: int | None = None,
+    hostfile: str | None = None,
+    *,
+    port: int = DEFAULT_PORT,
+) -> bool:
+    """Initialize jax.distributed for a multi-host run; returns whether a
+    multi-process rendezvous actually started.
+
+    Resolution order matches how jobs launch in practice:
+    1. No hostfile and no multi-process env -> single-process, no-op.
+    2. TPU pod environment (runtime-injected coordinator) ->
+       ``jax.distributed.initialize()`` with no arguments.
+    3. Hostfile + procs_id -> explicit coordinator/num_processes/rank,
+       the reference's ``-procsID``+hostfile contract (main.cc:13-18).
+    """
+    import jax
+
+    if hostfile is None:
+        explicit = any(
+            v in os.environ
+            for v in ("COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+        )
+        workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multi_worker = len([w for w in workers.split(",") if w]) > 1
+        if not explicit and not workers:
+            return False
+        try:
+            jax.distributed.initialize()
+            return True
+        except (ValueError, RuntimeError):
+            if explicit or multi_worker:
+                # a pod-shaped environment that fails to rendezvous must
+                # not silently degrade to N independent same-seed trainers
+                raise
+            # single-host tunnels set TPU_WORKER_HOSTNAMES with one entry;
+            # falling back to single-process is correct there, but say so
+            print(
+                "singa_tpu: jax.distributed.initialize() declined "
+                "(single-host TPU environment); running single-process",
+                file=sys.stderr,
+            )
+            return False
+    hosts = read_hostfile(hostfile)
+    if len(hosts) <= 1:
+        return False
+    if procs_id is None or not 0 <= procs_id < len(hosts):
+        raise ValueError(
+            f"procs_id {procs_id!r} out of range for {len(hosts)} hosts"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address(hosts, port),
+        num_processes=len(hosts),
+        process_id=procs_id,
+    )
+    return True

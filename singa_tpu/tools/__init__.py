@@ -1,0 +1,7 @@
+"""Operator tooling (the reference's script/ + examples/mnist/*.sh):
+
+  graph      net-JSON -> graphviz dot          (script/graph.py)
+  draw       training-log curves -> PNG        (script/draw.py)
+  partition  record lists across worker groups (script/load_data.py)
+  sweep      scaling sweep over mesh sizes     (examples/mnist/batch.sh)
+"""

@@ -1,0 +1,141 @@
+"""Elastic/affine distortion tests (the reference's configured-but-
+disabled MnistImageLayer pipeline, layer.cc:408-440)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.ops.distortion import (
+    affine_matrices,
+    distort,
+    elastic_offsets,
+    gaussian_kernel1d,
+)
+
+
+def test_gaussian_kernel_normalized():
+    k = gaussian_kernel1d(7, 2.0)
+    assert k.shape == (7,)
+    np.testing.assert_allclose(float(jnp.sum(k)), 1.0, rtol=1e-6)
+    assert float(k[3]) == float(jnp.max(k))  # peak at center
+
+
+def test_elastic_offsets_shape_and_scale():
+    dy, dx = elastic_offsets(
+        jax.random.PRNGKey(0), (4, 28, 28), kernel=9, sigma=3.0, alpha=8.0
+    )
+    assert dy.shape == dx.shape == (4, 28, 28)
+    # smoothed uniform noise stays within +-alpha
+    assert float(jnp.max(jnp.abs(dy))) <= 8.0
+    # smoothing leaves spatial correlation: neighbors differ less than
+    # the field's overall spread
+    diff = float(jnp.mean(jnp.abs(dy[:, 1:] - dy[:, :-1])))
+    spread = float(jnp.std(dy))
+    assert diff < spread
+
+
+def test_affine_identity_at_zero():
+    mats = affine_matrices(jax.random.PRNGKey(0), 5, beta=0.0, gamma=0.0)
+    np.testing.assert_allclose(
+        np.asarray(mats), np.tile(np.eye(2), (5, 1, 1)), atol=1e-6
+    )
+
+
+def test_distort_noop_when_disabled():
+    imgs = jax.random.uniform(jax.random.PRNGKey(1), (2, 16, 16))
+    out = distort(imgs, jax.random.PRNGKey(2))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(imgs), atol=1e-5)
+
+
+def test_distort_preserves_mass_roughly():
+    """Small distortions move pixels around, not away: mean intensity is
+    approximately preserved (boundary zero-fill loses a little)."""
+    imgs = jnp.ones((3, 28, 28)) * 0.5
+    out = distort(
+        imgs, jax.random.PRNGKey(0), kernel=9, sigma=4.0, alpha=4.0,
+        beta=10.0, gamma=5.0,
+    )
+    assert out.shape == imgs.shape
+    assert 0.4 < float(jnp.mean(out)) < 0.55
+
+
+def test_distort_changes_image_and_is_deterministic():
+    imgs = jax.random.uniform(jax.random.PRNGKey(3), (2, 28, 28))
+    a = distort(imgs, jax.random.PRNGKey(7), kernel=7, sigma=3.0, alpha=6.0)
+    b = distort(imgs, jax.random.PRNGKey(7), kernel=7, sigma=3.0, alpha=6.0)
+    c = distort(imgs, jax.random.PRNGKey(8), kernel=7, sigma=3.0, alpha=6.0)
+    assert float(jnp.max(jnp.abs(a - imgs))) > 0.01
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert float(jnp.max(jnp.abs(a - c))) > 1e-4  # rng-driven
+
+
+def test_distort_jits():
+    imgs = jnp.zeros((2, 16, 16))
+    fn = jax.jit(
+        lambda x, r: distort(x, r, kernel=5, sigma=2.0, alpha=3.0, beta=5.0)
+    )
+    out = fn(imgs, jax.random.PRNGKey(0))
+    assert out.shape == imgs.shape
+
+
+@pytest.mark.parametrize("resize", [0, 20])
+def test_mnist_layer_distortion_end_to_end(tmp_path, resize):
+    """A kMnistImage layer with distortion knobs trains and augments only
+    in training mode."""
+    from singa_tpu.config import parse_model_config
+    from singa_tpu.data.loader import synthetic_arrays, write_records
+    from singa_tpu.graph.builder import build_net
+    from singa_tpu.params import init_params
+
+    shard = str(tmp_path / "shard")
+    write_records(shard, *synthetic_arrays(32, seed=0))
+    size = resize or 28
+    conf = f"""
+name: "distort"
+train_steps: 2
+updater {{ base_learning_rate: 0.1 param_type: "Param" }}
+neuralnet {{
+  layer {{ name: "data" type: "kShardData"
+          data_param {{ path: "{shard}" batchsize: 8 }} }}
+  layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+          mnist_param {{ norm_a: 255 norm_b: 0 kernel: 7 sigma: 3
+                        alpha: 6 beta: 10 gamma: 5 resize: {resize} }} }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{ name: "fc" type: "kInnerProduct" srclayers: "mnist"
+          inner_product_param {{ num_output: 10 }}
+          param {{ name: "w" init_method: "kUniformSqrtFanIn" }}
+          param {{ name: "b" init_method: "kConstant" value: 0 }} }}
+  layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "fc" srclayers: "label"
+          softmaxloss_param {{ topk: 1 }} }}
+}}
+"""
+    cfg = parse_model_config(conf)
+    net = build_net(cfg, "kTrain")
+    assert net.name2layer["mnist"].out_shape == (8, size, size)
+
+    params = init_params(jax.random.PRNGKey(0), net.param_specs())
+    (dl,) = net.datalayers
+    batch = {
+        "data": {
+            "image": jnp.asarray(dl.images[:8]),
+            "label": jnp.asarray(dl.labels[:8]),
+        }
+    }
+    rng = jax.random.PRNGKey(5)
+    _, _, acts_train = net.forward(
+        params, batch, training=True, rng=rng, return_acts=True
+    )
+    _, _, acts_eval = net.forward(
+        params, batch, training=False, return_acts=True
+    )
+    a, b = acts_train["mnist"], acts_eval["mnist"]
+    assert a.shape == (8, size, size)
+    # augmentation perturbs training activations but never eval
+    assert float(jnp.max(jnp.abs(a - b))) > 1e-3
+    _, _, acts_eval2 = net.forward(
+        params, batch, training=False, return_acts=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(acts_eval["mnist"]), np.asarray(acts_eval2["mnist"])
+    )

@@ -204,14 +204,18 @@ def test_slice_concate_split_dataflow(shard_dir, tmp_path):
     assert np.isfinite(float(loss))
 
 
-def test_lmdb_layer_gated(tmp_path):
+def test_lmdb_layer_missing_db_rejected(tmp_path):
+    """kLMDBData is a real layer now (tests/test_lmdb.py); a missing
+    database must still fail loudly at build time."""
+    from singa_tpu.data.lmdbio import LMDBError
+
     cfg = ModelConfig.from_text("""
         neuralnet {
           layer { name: "data" type: "kLMDBData"
                   data_param { path: "/nope" batchsize: 4 } }
         }
     """)
-    with pytest.raises(ConfigError, match="kShardData"):
+    with pytest.raises(LMDBError, match="cannot open"):
         build_net(cfg, "kTrain")
 
 

@@ -1,0 +1,85 @@
+"""ImageNet-layout loader tests (reference ImageNetSource,
+tools/data_loader/data_source.cc:97-196): folder/img + folder/rid.txt,
+resize, channel-major records, resumable append."""
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from singa_tpu.data.loader import (  # noqa: E402
+    compute_mean,
+    load_label_lines,
+    write_imagenet,
+)
+from singa_tpu.data.pipeline import load_shard_arrays  # noqa: E402
+
+
+def _make_dataset(root, n=6, classes=3, size=(40, 30)):
+    """Write n solid-color JPEGs under root/img + root/rid.txt."""
+    img_dir = root / "img" / "n01"
+    img_dir.mkdir(parents=True)
+    lines = []
+    for i in range(n):
+        color = (40 * i % 256, 80 * i % 256, 120 * i % 256)
+        im = Image.new("RGB", size, color)
+        rel = f"n01/im{i}.jpg"
+        im.save(root / "img" / rel, quality=95)
+        lines.append(f"{rel} {i % classes}")
+    (root / "rid.txt").write_text("\n".join(lines) + "\n")
+    return lines
+
+
+def test_label_lines_parse(tmp_path):
+    (tmp_path / "rid.txt").write_text("a/b.jpg 3\nc.png 0\n")
+    assert load_label_lines(str(tmp_path / "rid.txt")) == [
+        ("a/b.jpg", 3),
+        ("c.png", 0),
+    ]
+
+
+def test_label_lines_odd_tokens_rejected(tmp_path):
+    (tmp_path / "rid.txt").write_text("a.jpg 1 b.jpg\n")
+    with pytest.raises(ValueError, match="odd token"):
+        load_label_lines(str(tmp_path / "rid.txt"))
+
+
+def test_imagenet_to_shard(tmp_path):
+    _make_dataset(tmp_path)
+    out = str(tmp_path / "shard")
+    assert write_imagenet(str(tmp_path), out, size=16) == 6
+    images, labels = load_shard_arrays(out)
+    assert images.shape == (6, 3, 16, 16)
+    assert list(labels) == [0, 1, 2, 0, 1, 2]
+    # solid-color inputs survive resize: every pixel equals the fill color
+    # (JPEG quantization allows small wobble)
+    im0 = images[0]
+    assert float(np.ptp(im0.reshape(3, -1), axis=1).max()) <= 4.0
+
+
+def test_append_resume_skips_existing(tmp_path):
+    _make_dataset(tmp_path)
+    out = str(tmp_path / "shard")
+    assert write_imagenet(str(tmp_path), out, size=8) == 6
+    # re-run: same keys -> dedup, nothing inserted (crash-resume semantics)
+    assert write_imagenet(str(tmp_path), out, size=8) == 0
+    images, _ = load_shard_arrays(out)
+    assert images.shape[0] == 6
+
+
+def test_invalid_image_skipped(tmp_path):
+    _make_dataset(tmp_path, n=3)
+    (tmp_path / "img" / "n01" / "bad.jpg").write_bytes(b"not an image")
+    rid = tmp_path / "rid.txt"
+    rid.write_text(rid.read_text() + "n01/bad.jpg 9\n")
+    out = str(tmp_path / "shard")
+    assert write_imagenet(str(tmp_path), out, size=8) == 3
+
+
+def test_compute_mean_over_imagenet_shard(tmp_path):
+    _make_dataset(tmp_path)
+    out = str(tmp_path / "shard")
+    write_imagenet(str(tmp_path), out, size=8)
+    mean = compute_mean(out, str(tmp_path / "mean.npy"))
+    assert mean.shape == (3, 8, 8)
