@@ -500,6 +500,10 @@ class ModelConfig(Message):
         "checkpoint": Field("string"),
         "checkpoint_frequency": Field("int", 0),
         "checkpoint_after_steps": Field("int", 0),
+        # --- singa-tpu extension: mixed-precision compute. Params stay
+        # fp32 (master copies, updater math in fp32); forward/backward
+        # matmuls run in this dtype so the MXU sees bf16. "" = fp32. ---
+        "compute_dtype": Field("string", ""),
     }
 
 

@@ -118,6 +118,18 @@ class BatchPipeline:
         self._queue: queue.Queue | None = None
         self._thread: threading.Thread | None = None
 
+    @property
+    def position(self) -> int:
+        """Current stream position (record index of the next batch)."""
+        return self._pos
+
+    def advance(self, nsteps: int) -> None:
+        """Skip ``nsteps`` batches: the device-side chunk engine consumed
+        them via on-device index math (Trainer.train_chunk)."""
+        if self._thread is not None:
+            raise RuntimeError("advance() after prefetch started")
+        self._pos = int((self._pos + nsteps * self.batchsize) % self.n)
+
     def _next_indices(self) -> np.ndarray:
         idx = (self._pos + np.arange(self.batchsize)) % self.n
         self._pos = int((self._pos + self.batchsize) % self.n)

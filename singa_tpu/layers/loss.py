@@ -79,7 +79,8 @@ class EuclideanLossLayer(Layer):
         return src_shapes[0]
 
     def apply(self, params, inputs, *, training, rng=None):
-        pred = inputs[0].reshape(inputs[0].shape[0], -1)
-        target = inputs[1].reshape(inputs[1].shape[0], -1)
+        # accumulate the reduction in fp32 even under bf16 compute
+        pred = inputs[0].reshape(inputs[0].shape[0], -1).astype(jnp.float32)
+        target = inputs[1].reshape(inputs[1].shape[0], -1).astype(jnp.float32)
         loss = 0.5 * jnp.mean(jnp.sum(jnp.square(pred - target), axis=1))
         return loss, {"loss": loss}

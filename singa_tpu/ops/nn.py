@@ -146,6 +146,9 @@ def softmax_loss(
     reference's hand-written gradient (prob - onehot) * scale / batchsize.
     """
     labels = labels.astype(jnp.int32)
+    # loss math in fp32 even under bf16 compute: softmax/log are where
+    # reduced precision actually hurts, and this op is not matmul-bound
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     n = logits.shape[0]
     true_logp = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]

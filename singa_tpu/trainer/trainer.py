@@ -135,10 +135,25 @@ class Trainer:
                 "(pass -cluster_conf with a workspace field)"
             )
 
+        # --- mixed precision (singa-tpu extension, ModelProto.compute_dtype)
+        self._compute_dtype = None
+        if model_cfg.compute_dtype:
+            try:
+                dt = jnp.dtype(model_cfg.compute_dtype)
+            except TypeError:
+                raise ConfigError(
+                    f"unknown compute_dtype {model_cfg.compute_dtype!r}"
+                ) from None
+            if dt != jnp.float32:
+                self._compute_dtype = dt
+
         # --- the one compiled program ---
         self._train_step = jax.jit(
             self._train_step_entry, donate_argnums=(0, 1)
         )
+        # multi-step chunks: scan over the same step body, one dispatch
+        # per cadence window instead of per batch (cache keyed by length)
+        self._chunk_fns: dict[int, Callable] = {}
         self._eval_steps: dict[int, Callable] = {}
         self._batch_size = self.train_net.batchsize
 
@@ -238,10 +253,25 @@ class Trainer:
         batch = self._resolve_batch(self.train_net, batch)
         return self._train_step_fn(params, state, step, batch, rng)
 
+    def _cast_compute(self, tree):
+        """Cast float leaves to the compute dtype (bf16 matmuls on the
+        MXU); params keep fp32 masters — the cast sits inside loss_fn so
+        its transpose upcasts the grads back to fp32 automatically."""
+        if self._compute_dtype is None:
+            return tree
+        dt = self._compute_dtype
+        return jax.tree.map(
+            lambda x: x.astype(dt)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
     def _train_step_fn(self, params, state, step, batch, rng):
         def loss_fn(p):
             loss, metrics = self.train_net.forward(
-                p, batch, training=True, rng=rng
+                self._cast_compute(p), self._cast_compute(batch),
+                training=True, rng=rng,
             )
             return loss, metrics
 
@@ -256,7 +286,10 @@ class Trainer:
 
             def eval_fn(params, batch):
                 batch = self._resolve_batch(net, batch)
-                _, metrics = net.forward(params, batch, training=False)
+                _, metrics = net.forward(
+                    self._cast_compute(params), self._cast_compute(batch),
+                    training=False,
+                )
                 return metrics
 
             self._eval_steps[id(net)] = jax.jit(eval_fn)
@@ -303,6 +336,117 @@ class Trainer:
             )
         self.perf.update(metrics)
 
+    # ------------------------------------------------------------------
+    # multi-step chunks (device-cached datasets only)
+    # ------------------------------------------------------------------
+
+    def _can_chunk(self) -> bool:
+        """Chunking folds N steps into one lax.scan dispatch. It needs the
+        dataset on device (batch = index math inside the program) and no
+        per-step host work (debug dumps want _last_batch)."""
+        if not self._cached or self.cfg.debug:
+            return False
+        return self._chunk_cap() > 1
+
+    def _chunk_cap(self) -> int:
+        return int(os.environ.get("SINGA_TPU_CHUNK", "64"))
+
+    def _make_chunk_fn(self, nsteps: int) -> Callable:
+        pipes = self._pipelines[id(self.train_net)]
+        meta = {
+            name: (pipes[name].batchsize, pipes[name].n)
+            for name in self._dev_data[id(self.train_net)]
+        }
+
+        # the cached dataset enters as an ARGUMENT, not a closure capture:
+        # captured arrays lower to embedded constants, which some runtimes
+        # re-upload on every execution (catastrophic through a tunneled
+        # device); as an argument it stays resident and is passed by ref
+        def chunk_fn(params, state, step0, pos0s, data):
+            def body(carry, i):
+                params, state = carry
+                step = step0 + i
+                batch = {}
+                for name, d in data.items():
+                    bs, n = meta[name]
+                    idx = (pos0s[name] + i * bs + jnp.arange(bs)) % n
+                    batch[name] = {"__idx__": idx, **d}
+                batch = self._resolve_batch(self.train_net, batch)
+                rng = jax.random.fold_in(self._step_key, step)
+                params, state, metrics = self._train_step_fn(
+                    params, state, step, batch, rng
+                )
+                return (params, state), metrics
+
+            (params, state), metrics = jax.lax.scan(
+                body, (params, state), jnp.arange(nsteps)
+            )
+            # sum the per-step metrics inside the program: one dispatch
+            # total, no (nsteps,)-stacked metrics round trip
+            return params, state, jax.tree.map(
+                lambda a: a.sum(axis=0), metrics
+            )
+
+        return jax.jit(chunk_fn, donate_argnums=(0, 1))
+
+    def train_chunk(self, step0: int, nsteps: int) -> None:
+        """Run nsteps consecutive train steps as ONE compiled program.
+
+        Semantically identical to nsteps train_one_batch calls: the same
+        sequential-wraparound batch indices (computed on device from the
+        stream positions), the same per-step rng folds, the same updater
+        schedule (each scan iteration sees its true step number)."""
+        if nsteps not in self._chunk_fns:
+            self._chunk_fns[nsteps] = self._make_chunk_fn(nsteps)
+        pipes = self._pipelines[id(self.train_net)]
+        pos0s = {
+            name: jnp.int32(pipe.position) for name, pipe in pipes.items()
+        }
+        with self.timers.phase("train"):
+            self.params, self.state, summed = self._chunk_fns[nsteps](
+                self.params, self.state, jnp.int32(step0), pos0s,
+                self._dev_data[id(self.train_net)],
+            )
+        for name, pipe in pipes.items():
+            pipe.advance(nsteps)
+        # metrics arrive pre-summed over the chunk; Performance pulls to
+        # host only at display time
+        self.perf.update_summed(summed, nsteps)
+
+    def _next_fire(self, cur: int, freq: int, after: int) -> float:
+        """Smallest s >= cur with _now(s, freq, after), or +inf."""
+        if freq <= 0:
+            return float("inf")
+        base = max(cur, after)
+        return base + (-(base - after)) % freq
+
+    def _chunk_len(self, step: int) -> int:
+        """Steps until the next cadence event bounds the chunk: val/test
+        run BEFORE their trigger step (chunk must stop short of it);
+        display/checkpoint run AFTER theirs (it may close the chunk)."""
+        cfg = self.cfg
+        n = min(cfg.train_steps - step, self._chunk_cap())
+        if self.val_net is not None:
+            fire = self._next_fire(
+                step + 1, cfg.validation_frequency, cfg.validation_after_steps
+            )
+            n = min(n, fire - step)
+        if self.test_net is not None:
+            fire = self._next_fire(
+                step + 1, cfg.test_frequency, cfg.test_after_steps
+            )
+            n = min(n, fire - step)
+        fire = self._next_fire(
+            step, cfg.display_frequency, cfg.display_after_steps
+        )
+        n = min(n, fire - step + 1)
+        # checkpoint at step s saves "done = s+1" (see run_one_batch)
+        fire = self._next_fire(
+            step + 1, cfg.checkpoint_frequency, cfg.checkpoint_after_steps
+        )
+        n = min(n, fire - step)
+        return max(1, int(n))
+
     def _eval_params(self):
         """Params used by eval steps; replica trainers override this to
         evaluate a single replica's view."""
@@ -320,8 +464,9 @@ class Trainer:
         self.log(f"step {step}: {phase} {perf.to_string()}")
         return avg
 
-    def run_one_batch(self, step: int) -> None:
-        """RunOneBatch (worker.cc:187-213): cadences around the train step."""
+    def _pre_events(self, step: int) -> None:
+        """Validation/test run BEFORE the train step of their trigger step
+        (worker.cc:190-200)."""
         cfg = self.cfg
         if self.val_net is not None and _now(
             step, cfg.validation_frequency, cfg.validation_after_steps
@@ -333,7 +478,10 @@ class Trainer:
             step, cfg.test_frequency, cfg.test_after_steps
         ):
             self.evaluate(self.test_net, cfg.test_steps, "test", step)
-        self.train_one_batch(step)
+
+    def _post_events(self, step: int) -> None:
+        """Display/checkpoint run AFTER the train step."""
+        cfg = self.cfg
         if _now(step, cfg.display_frequency, cfg.display_after_steps):
             sps = 0.0
             t = self.timers.total("train") + self.timers.total("data")
@@ -358,8 +506,18 @@ class Trainer:
         ):
             self.save(done)
 
+    def run_one_batch(self, step: int) -> None:
+        """RunOneBatch (worker.cc:187-213): cadences around the train step."""
+        self._pre_events(step)
+        self.train_one_batch(step)
+        self._post_events(step)
+
     def run(self) -> None:
-        """Worker::Run (worker.cc:98-106): the full training loop."""
+        """Worker::Run (worker.cc:98-106): the full training loop.
+
+        With a device-cached dataset the loop advances in multi-step
+        chunks (one compiled scan per cadence window); otherwise it is the
+        reference's step-at-a-time loop."""
         if self.cluster is not None and self.cluster.workspace:
             vis = os.path.join(
                 self.cluster.workspace, self.cluster.vis_subfolder
@@ -367,8 +525,17 @@ class Trainer:
             for net in (self.train_net, self.test_net, self.val_net):
                 if net is not None:
                     dump_net_json(net, vis)
-        for step in range(self.start_step, self.cfg.train_steps):
-            self.run_one_batch(step)
+        chunking = self._can_chunk()
+        step = self.start_step
+        while step < self.cfg.train_steps:
+            n = self._chunk_len(step) if chunking else 1
+            self._pre_events(step)
+            if n > 1:
+                self.train_chunk(step, n)
+            else:
+                self.train_one_batch(step)
+            self._post_events(step + n - 1)
+            step += n
         if self._checkpoint_dir() is not None:
             self.save(self.cfg.train_steps)
 

@@ -31,6 +31,13 @@ class Performance:
             for k, v in m.items():
                 bucket[k] = v if k not in bucket else bucket[k] + v
 
+    def update_summed(self, summed: dict[str, dict], nsteps: int) -> None:
+        """Accumulate ``nsteps`` steps whose metrics are already summed
+        on device (the chunk engine's lax.scan output reduced over its
+        step axis) — no per-step host transfer, same averages."""
+        self.update(summed)
+        self._count += nsteps - 1
+
     @property
     def count(self) -> int:
         return self._count

@@ -1,0 +1,177 @@
+"""Multi-step chunk engine + mixed-precision tests.
+
+The chunk path (Trainer.train_chunk: lax.scan over the step body with
+on-device batch index math) must be bit-equivalent to the step-at-a-time
+loop — same stream positions, same rng folds, same updater schedule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.config import parse_model_config
+from singa_tpu.data.loader import synthetic_arrays, write_records
+from singa_tpu.trainer import Trainer
+
+
+def _conf(shard, extra="", steps=12, batch=16):
+    return parse_model_config(f"""
+name: "chunk-test"
+train_steps: {steps}
+{extra}
+updater {{ base_learning_rate: 0.1 momentum: 0.9 param_type: "Param" }}
+neuralnet {{
+  layer {{ name: "data" type: "kShardData"
+          data_param {{ path: "{shard}" batchsize: {batch} }} }}
+  layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+          mnist_param {{ norm_a: 255 norm_b: 0 }} }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{ name: "fc" type: "kInnerProduct" srclayers: "mnist"
+          inner_product_param {{ num_output: 10 }}
+          param {{ name: "w" init_method: "kUniformSqrtFanIn" }}
+          param {{ name: "b" init_method: "kConstant" value: 0 }} }}
+  layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "fc" srclayers: "label"
+          softmaxloss_param {{ topk: 1 }} }}
+}}
+""")
+
+
+@pytest.fixture
+def shard(tmp_path):
+    path = str(tmp_path / "shard")
+    # 40 records with batch 16 -> wraparound inside the chunk
+    write_records(path, *synthetic_arrays(40, seed=2))
+    return path
+
+
+def test_chunk_equals_stepwise(shard):
+    """N steps via one train_chunk == N train_one_batch calls."""
+    a = Trainer(_conf(shard), seed=3, log=lambda s: None, prefetch=False)
+    b = Trainer(_conf(shard), seed=3, log=lambda s: None, prefetch=False)
+    assert a._can_chunk()
+
+    for step in range(6):
+        a.train_one_batch(step)
+    b.train_chunk(0, 6)
+
+    for name in a.params:
+        np.testing.assert_allclose(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            rtol=1e-6, atol=1e-6, err_msg=name,
+        )
+    # stream positions advanced identically
+    (pa,) = a._pipelines[id(a.train_net)].values()
+    (pb,) = b._pipelines[id(b.train_net)].values()
+    assert pa.position == pb.position
+    # metrics arrived per step
+    assert a.perf.count == b.perf.count == 6
+
+
+def test_chunked_run_equals_stepwise_run(shard):
+    """Full run() with chunking == run() with chunking disabled."""
+    a = Trainer(_conf(shard), seed=1, log=lambda s: None, prefetch=False)
+    b = Trainer(_conf(shard), seed=1, log=lambda s: None, prefetch=False)
+    chunks = []
+    orig = Trainer.train_chunk
+
+    def spy(self, step0, nsteps):
+        chunks.append((step0, nsteps))
+        return orig(self, step0, nsteps)
+
+    b.train_chunk = spy.__get__(b)
+    a._can_chunk = lambda: False
+    a.run()
+    b.run()
+    assert chunks, "chunk path never engaged"
+    assert sum(n for _, n in chunks) == 12
+    for name in a.params:
+        np.testing.assert_allclose(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            rtol=1e-6, atol=1e-6, err_msg=name,
+        )
+
+
+def test_chunk_respects_cadences(shard):
+    """Chunks stop at test/display boundaries; events still fire."""
+    extra = """
+test_steps: 1
+test_frequency: 5
+display_frequency: 4
+"""
+    logs = []
+    tr = Trainer(
+        _conf(shard, extra), seed=0, log=logs.append, prefetch=False
+    )
+    tr.run()
+    # display at steps 0,4,8; test evaluates at 5,10 (after_steps=0 means
+    # step 0 fires too)
+    displays = [l for l in logs if "train" in l]
+    tests = [l for l in logs if "test" in l]
+    assert len(displays) == 3
+    assert len(tests) == 3  # steps 0, 5, 10
+
+
+def test_chunk_len_math(shard):
+    tr = Trainer(
+        _conf(shard, "display_frequency: 10", steps=100),
+        seed=0, log=lambda s: None, prefetch=False,
+    )
+    # display fires at 10,20,... -> from step 1 the chunk may run through
+    # step 10 inclusive (display is a post-event)
+    assert tr._chunk_len(1) == 10
+    assert tr._chunk_len(10) == 1  # display closes every chunk at 10,20...
+    assert tr._chunk_len(11) == 10
+
+
+def test_checkpoint_cadence_inside_chunked_run(shard, tmp_path):
+    from singa_tpu.config import parse_cluster_config
+
+    cluster = parse_cluster_config(
+        f'nworkers: 1 workspace: "{tmp_path}/ws"'
+    )
+    cfg = _conf(shard, "checkpoint_frequency: 5", steps=12)
+    tr = Trainer(cfg, cluster, seed=0, log=lambda s: None, prefetch=False)
+    tr.run()
+    import os
+
+    saved = sorted(os.listdir(f"{tmp_path}/ws/checkpoints"))
+    assert saved == ["step_10.npz", "step_12.npz", "step_5.npz"]
+
+
+def test_bf16_compute_trains(shard):
+    cfg = _conf(shard, 'compute_dtype: "bfloat16"', steps=20)
+    tr = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
+    assert tr._compute_dtype == jnp.bfloat16
+    losses = []
+    for step in range(20):
+        tr.train_one_batch(step)
+        (m,) = tr.perf.avg().values()
+        losses.append(m["loss"])
+        tr.perf.reset()
+    # params stay fp32 masters
+    assert all(v.dtype == jnp.float32 for v in tr.params.values())
+    assert losses[-1] < losses[0]
+
+
+def test_bf16_close_to_fp32(shard):
+    """One bf16 step lands near the fp32 step (bf16 has ~3 digits)."""
+    a = Trainer(_conf(shard), seed=0, log=lambda s: None, prefetch=False)
+    b = Trainer(
+        _conf(shard, 'compute_dtype: "bfloat16"'),
+        seed=0, log=lambda s: None, prefetch=False,
+    )
+    a.train_one_batch(0)
+    b.train_one_batch(0)
+    for name in a.params:
+        np.testing.assert_allclose(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            rtol=0.05, atol=0.02, err_msg=name,
+        )
+
+
+def test_unknown_compute_dtype_rejected(shard):
+    from singa_tpu.config.schema import ConfigError
+
+    cfg = _conf(shard, 'compute_dtype: "float99"')
+    with pytest.raises(ConfigError, match="compute_dtype"):
+        Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
