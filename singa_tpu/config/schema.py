@@ -222,6 +222,23 @@ class SplitConfig(Message):
     FIELDS = {"num_splits": Field("int")}
 
 
+class GlobalPoolingConfig(Message):
+    """singa-tpu extension: kGlobalPooling has no kernel/stride — only the
+    method (AVE default, the ResNet convention)."""
+
+    FIELDS = {"pool": Field("enum", "AVE", enum=POOL_METHODS)}
+
+
+class BatchNormConfig(Message):
+    """singa-tpu extension (no counterpart in model.proto — the reference
+    predates batch norm); configures layers/norm.py BatchNormLayer."""
+
+    FIELDS = {
+        "momentum": Field("float", 0.9),
+        "eps": Field("float", 1e-5),
+    }
+
+
 class TanhConfig(Message):
     # scaled tanh: outer_scale * tanh(inner_scale * x); defaults are 1.0 but
     # the reference kTanh layer always uses the LeCun constants (stanh,
@@ -367,6 +384,8 @@ class LayerConfig(Message):
         "param": Field("message", repeated=True, message=ParamConfig),
         "share_param": Field("string", repeated=True),
         "exclude": Field("enum", repeated=True, enum=PHASES),
+        "batchnorm_param": Field("message", message=BatchNormConfig),
+        "globalpooling_param": Field("message", message=GlobalPoolingConfig),
         "convolution_param": Field("message", message=ConvolutionConfig),
         "concate_param": Field("message", message=ConcateConfig),
         "data_param": Field("message", message=DataConfig),

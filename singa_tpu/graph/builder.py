@@ -107,6 +107,19 @@ class Net:
                 specs[name] = spec
         return specs
 
+    def buffer_specs(self) -> dict:
+        """Non-trainable state (BufferSpec) declared by stateful layers."""
+        specs = {}
+        for layer in self.layers:
+            specs.update(layer.buffer_specs())
+        return specs
+
+    def init_buffers(self) -> dict[str, jnp.ndarray]:
+        return {
+            name: jnp.full(spec.shape, spec.init, dtype=jnp.float32)
+            for name, spec in self.buffer_specs().items()
+        }
+
     # ---------------- trace ----------------
 
     def forward(
@@ -116,6 +129,8 @@ class Net:
         *,
         training: bool,
         rng: jax.Array | None = None,
+        buffers: dict[str, jnp.ndarray] | None = None,
+        return_buffers: bool = False,
         return_acts: bool = False,
         layer_hook=None,
     ):
@@ -130,7 +145,15 @@ class Net:
         hook(layer, resolved_params, inputs, layer_rng); a non-None return
         replaces layer.apply — this is how the CD trainer swaps RBM layers
         to Gibbs-chain updates without re-implementing the traversal.
+
+        ``buffers`` feeds stateful layers (batch norm running stats);
+        omitted, they use their init values. With ``return_buffers`` the
+        post-step buffer dict is appended (before acts): the trainer
+        carries it between steps.
         """
+        if buffers is None:
+            buffers = self.init_buffers()
+        new_buffers = dict(buffers)
         resolved = dict(params)
         for layer in self.layers:
             for name, spec in layer.param_specs().items():
@@ -158,9 +181,16 @@ class Net:
             if layer_hook is not None:
                 out = layer_hook(layer, resolved, inputs, lrng)
             if out is None:
-                out = layer.apply(
-                    resolved, inputs, training=training, rng=lrng
-                )
+                if layer.has_buffers:
+                    out, updates = layer.apply_stateful(
+                        resolved, buffers, inputs,
+                        training=training, rng=lrng,
+                    )
+                    new_buffers.update(updates)
+                else:
+                    out = layer.apply(
+                        resolved, inputs, training=training, rng=lrng
+                    )
             if layer.is_losslayer:
                 loss, m = out
                 total_loss = total_loss + loss
@@ -168,9 +198,12 @@ class Net:
                 acts[layer.name] = loss
             else:
                 acts[layer.name] = out
+        extra = []
+        if return_buffers:
+            extra.append(new_buffers)
         if return_acts:
-            return total_loss, metrics, acts
-        return total_loss, metrics
+            extra.append(acts)
+        return (total_loss, metrics, *extra)
 
     # ---------------- observability ----------------
 

@@ -25,6 +25,7 @@ from .data import (
     ShardDataLayer,
 )
 from .loss import EuclideanLossLayer, SoftmaxLossLayer
+from .norm import AddLayer, BatchNormLayer, GlobalPoolingLayer
 from .rbm import RBMLayer
 from .neuron import (
     ConvolutionLayer,
@@ -62,10 +63,14 @@ def registered_types() -> list[str]:
 
 
 # the reference's 18 built-ins (neuralnet.cc:13-33) + extensions:
-# kSigmoid, kRBM + kEuclideanLoss (the CD/autoencoder path, BASELINE #4)
+# kSigmoid, kRBM + kEuclideanLoss (the CD/autoencoder path, BASELINE #4),
+# kBatchNorm/kAdd/kGlobalPooling (the ResNet vocabulary, BASELINE #5)
 for _cls in (
     RBMLayer,
     EuclideanLossLayer,
+    AddLayer,
+    BatchNormLayer,
+    GlobalPoolingLayer,
     ConvolutionLayer,
     ConcateLayer,
     DropoutLayer,

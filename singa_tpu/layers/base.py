@@ -23,6 +23,19 @@ from ..params import ParamSpec
 Shape = tuple[int, ...]
 
 
+class BufferSpec:
+    """Non-trainable per-layer state (e.g. batch-norm running stats):
+    initialized to a fill value, updated by the layer's own rule inside
+    the step (never by the updater), checkpointed alongside params."""
+
+    __slots__ = ("name", "shape", "init")
+
+    def __init__(self, name: str, shape: Shape, init: float):
+        self.name = name
+        self.shape = tuple(shape)
+        self.init = init
+
+
 class Layer:
     """Base class; subclasses set TYPE and override setup/apply."""
 
@@ -45,6 +58,7 @@ class Layer:
         self.partition_type = cfg.partition_type or net_partition
         self.out_shape: Shape | None = None
         self._param_specs: dict[str, ParamSpec] = {}
+        self._buffer_specs: dict[str, BufferSpec] = {}
 
     # ---------------- build time ----------------
 
@@ -80,6 +94,20 @@ class Layer:
         )
         return qualified
 
+    def buffer_specs(self) -> dict[str, BufferSpec]:
+        return self._buffer_specs
+
+    def _declare_buffer(
+        self, default_name: str, shape: Shape, init: float = 0.0
+    ) -> str:
+        qualified = f"{self.name}/{default_name}"
+        self._buffer_specs[qualified] = BufferSpec(qualified, shape, init)
+        return qualified
+
+    @property
+    def has_buffers(self) -> bool:
+        return bool(self._buffer_specs)
+
     @property
     def partition_dim(self) -> int:
         return self.PARTITION_DIM_FOR[self.partition_type]
@@ -95,6 +123,19 @@ class Layer:
         rng: jax.Array | None = None,
     ) -> Any:
         """Pure forward; traced inside the jitted step."""
+        raise NotImplementedError
+
+    def apply_stateful(
+        self,
+        params: dict[str, jnp.ndarray],
+        buffers: dict[str, jnp.ndarray],
+        inputs: list[Any],
+        *,
+        training: bool,
+        rng: jax.Array | None = None,
+    ) -> tuple[Any, dict[str, jnp.ndarray]]:
+        """Forward for layers with buffers: returns (out, buffer updates).
+        Only called when ``has_buffers``."""
         raise NotImplementedError
 
     def __repr__(self) -> str:

@@ -1,0 +1,136 @@
+"""Normalization + residual layers (singa-tpu extensions).
+
+The reference predates batch normalization and residual networks (its
+layer registry tops out at LRN, src/worker/neuralnet.cc:13-33); these
+layers extend the same config surface so BASELINE.md's stretch target —
+ImageNet ResNet-50 (config 5) — is expressible as a plain job file.
+
+kBatchNorm's running statistics are the framework's first *buffers*:
+non-trainable state updated by the layer inside the jitted step and
+carried between steps by the trainer (layers/base.py BufferSpec). Under a
+data-sharded batch, GSPMD turns the batch-mean reductions into cross-chip
+psums automatically — i.e. sync BatchNorm over the whole global batch,
+with no BN-specific communication code.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..config.schema import ConfigError
+from .base import Layer, Shape, require_one_src
+
+
+class BatchNormLayer(Layer):
+    """kBatchNorm: per-channel batch normalization (NCHW axis 1, or the
+    feature axis of 2-D inputs).
+
+    Training normalizes by batch statistics and folds them into running
+    stats with Caffe's momentum convention
+    (running = momentum * running + (1 - momentum) * batch);
+    eval normalizes by the running stats.
+    """
+
+    TYPE = "kBatchNorm"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.batchnorm_param
+        self.momentum = p.momentum if p else 0.9
+        self.eps = p.eps if p else 1e-5
+        src = require_one_src(self, src_shapes)
+        if len(src) not in (2, 4):
+            raise ConfigError(
+                f"layer {self.name!r}: kBatchNorm needs (N,C,H,W) or (N,F) "
+                f"input, got {src}"
+            )
+        c = src[1]
+        self.gname = self._declare_param(
+            0, "gamma", (c,), neuron_axis=0
+        )
+        self.bname = self._declare_param(1, "beta", (c,), neuron_axis=0)
+        self.mean_buf = self._declare_buffer("running_mean", (c,), 0.0)
+        self.var_buf = self._declare_buffer("running_var", (c,), 1.0)
+        return src
+
+    def apply_stateful(self, params, buffers, inputs, *, training, rng=None):
+        x = inputs[0]
+        axes = (0,) if x.ndim == 2 else (0, 2, 3)
+        shape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
+        if training:
+            # stats in fp32 even under bf16 compute
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            m = self.momentum
+            updates = {
+                self.mean_buf: m * buffers[self.mean_buf] + (1 - m) * mean,
+                self.var_buf: m * buffers[self.var_buf] + (1 - m) * var,
+            }
+        else:
+            mean = buffers[self.mean_buf]
+            var = buffers[self.var_buf]
+            updates = {}
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        scale = (params[self.gname] * inv).astype(x.dtype).reshape(shape)
+        shift = (
+            params[self.bname] - params[self.gname] * mean * inv
+        ).astype(x.dtype).reshape(shape)
+        return x * scale + shift, updates
+
+    def apply(self, params, inputs, *, training, rng=None):
+        raise RuntimeError(
+            f"layer {self.name!r}: kBatchNorm is stateful; the net must "
+            "call apply_stateful (buffers plumbing)"
+        )
+
+
+class AddLayer(Layer):
+    """kAdd: elementwise sum of all srclayers — the residual connection.
+    Shapes must match exactly (use a projection conv on the shortcut when
+    they don't, like standard ResNet type-B shortcuts)."""
+
+    TYPE = "kAdd"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        if len(src_shapes) < 2:
+            raise ConfigError(
+                f"layer {self.name!r}: kAdd needs >= 2 srclayers"
+            )
+        first = src_shapes[0]
+        for s in src_shapes[1:]:
+            if tuple(s) != tuple(first):
+                raise ConfigError(
+                    f"layer {self.name!r}: kAdd shape mismatch {first} vs {s}"
+                )
+        return first
+
+    def apply(self, params, inputs, *, training, rng=None):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return out
+
+
+class GlobalPoolingLayer(Layer):
+    """kGlobalPooling: mean (AVE, default) or max over the spatial dims of
+    an NCHW input -> (N, C)."""
+
+    TYPE = "kGlobalPooling"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.globalpooling_param
+        self.pool = p.pool if p else "AVE"
+        src = require_one_src(self, src_shapes)
+        if len(src) != 4:
+            raise ConfigError(
+                f"layer {self.name!r}: kGlobalPooling needs NCHW input"
+            )
+        return (src[0], src[1])
+
+    def apply(self, params, inputs, *, training, rng=None):
+        x = inputs[0]
+        if self.pool == "MAX":
+            return jnp.max(x, axis=(2, 3))
+        return jnp.mean(x, axis=(2, 3))

@@ -38,7 +38,7 @@ def unroll_autoencoder(
     """
     from .checkpoint import load_checkpoint, save_checkpoint
 
-    step, params, state = load_checkpoint(ckpt_in)
+    step, params, state, _ = load_checkpoint(ckpt_in)
     out = dict(params)
     for rbm, dec in pairs:
         w = params.get(f"{rbm}/weight")
@@ -58,6 +58,8 @@ def unroll_autoencoder(
 
 class CDTrainer(Trainer):
     """Trainer whose compiled step does CD-k instead of backprop."""
+
+    _supports_buffers = False  # the CD step rewires forward via layer_hook
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -109,7 +111,8 @@ class CDTrainer(Trainer):
         """Eval metric per RBM: mean-field reconstruction error."""
         if id(net) not in self._eval_steps:
 
-            def eval_fn(params, batch):
+            def eval_fn(params, buffers, batch):
+                del buffers  # CD nets carry no stateful layers
                 batch = self._resolve_batch(net, batch)
                 metrics: dict = {}
 

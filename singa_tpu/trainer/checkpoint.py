@@ -25,6 +25,7 @@ import numpy as np
 _STEP_KEY = "__step__"
 _P = "p|"  # param arrays
 _S = "s|"  # updater slot arrays, "s|<param>|<slot>"
+_B = "b|"  # buffer arrays (stateful-layer state, e.g. BN running stats)
 
 
 def save_checkpoint(
@@ -32,6 +33,7 @@ def save_checkpoint(
     step: int,
     params: dict[str, jnp.ndarray],
     state: dict[str, dict[str, jnp.ndarray]] | None = None,
+    buffers: dict[str, jnp.ndarray] | None = None,
 ) -> str:
     """Atomic .npz snapshot; returns the final path."""
     arrays: dict[str, np.ndarray] = {_STEP_KEY: np.int64(step)}
@@ -40,6 +42,8 @@ def save_checkpoint(
     for name, slots in (state or {}).items():
         for slot, arr in slots.items():
             arrays[f"{_S}{name}|{slot}"] = np.asarray(arr)
+    for name, arr in (buffers or {}).items():
+        arrays[_B + name] = np.asarray(arr)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(path) or ".", suffix=".tmp"
@@ -56,26 +60,35 @@ def save_checkpoint(
 
 def load_checkpoint(
     path: str,
-) -> tuple[int, dict[str, np.ndarray], dict[str, dict[str, np.ndarray]]]:
-    """-> (step, params, state)."""
+) -> tuple[
+    int,
+    dict[str, np.ndarray],
+    dict[str, dict[str, np.ndarray]],
+    dict[str, np.ndarray],
+]:
+    """-> (step, params, state, buffers)."""
     with np.load(path) as z:
         step = int(z[_STEP_KEY])
         params: dict[str, np.ndarray] = {}
         state: dict[str, dict[str, np.ndarray]] = {}
+        buffers: dict[str, np.ndarray] = {}
         for key in z.files:
             if key.startswith(_P):
                 params[key[len(_P):]] = z[key]
             elif key.startswith(_S):
                 name, slot = key[len(_S):].rsplit("|", 1)
                 state.setdefault(name, {})[slot] = z[key]
-    return step, params, state
+            elif key.startswith(_B):
+                buffers[key[len(_B):]] = z[key]
+    return step, params, state, buffers
 
 
 def restore_into(
     path: str,
     params: dict[str, jnp.ndarray],
     state: dict[str, dict[str, jnp.ndarray]],
-) -> tuple[int, dict, dict]:
+    buffers: dict[str, jnp.ndarray] | None = None,
+) -> tuple[int, dict, dict, dict]:
     """Overlay a checkpoint onto freshly-initialized pytrees.
 
     Params present in the checkpoint replace their initialized values
@@ -83,7 +96,7 @@ def restore_into(
     params absent from it keep their init. Shape mismatches are an error —
     better loud than silently truncated.
     """
-    step, ck_params, ck_state = load_checkpoint(path)
+    step, ck_params, ck_state, ck_buffers = load_checkpoint(path)
     out_p = dict(params)
     for name, arr in ck_params.items():
         if name in out_p:
@@ -99,4 +112,13 @@ def restore_into(
             for slot, arr in slots.items():
                 if slot in out_s[name]:
                     out_s[name][slot] = jnp.asarray(arr)
-    return step, out_p, out_s
+    out_b = dict(buffers or {})
+    for name, arr in ck_buffers.items():
+        if name in out_b:
+            if tuple(arr.shape) != tuple(out_b[name].shape):
+                raise ValueError(
+                    f"checkpoint {path!r}: buffer {name!r} shape "
+                    f"{arr.shape} != model shape {out_b[name].shape}"
+                )
+            out_b[name] = jnp.asarray(arr)
+    return step, out_p, out_s, out_b

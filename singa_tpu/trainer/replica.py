@@ -58,6 +58,7 @@ class ReplicaTrainer(Trainer):
     # the vmapped step expects a leading replica axis on every batch leaf;
     # the shared device-cached dataset has none, so stay on the host path
     _allow_device_cache = False
+    _supports_buffers = False  # replica-axis vmap doesn't thread buffers
 
     def __init__(
         self,
@@ -322,7 +323,7 @@ class ReplicaTrainer(Trainer):
 
         from .checkpoint import restore_into
 
-        step, params, state = restore_into(path, self.params, self.state)
+        step, params, state, _ = restore_into(path, self.params, self.state)
         self.start_step = max(self.start_step, step)
         # restore_into returns uncommitted host arrays — put them back on
         # the replica shardings or the donating jit compiles unsharded
@@ -342,7 +343,7 @@ class ReplicaTrainer(Trainer):
             from .checkpoint import load_checkpoint
 
             repl = replicated(self.mesh)
-            _, sv_params, sv_state = load_checkpoint(server)
+            _, sv_params, sv_state, _ = load_checkpoint(server)
             ratio = sv_params.pop("__sample_ratio__", None)
             if ratio is not None:
                 self.sample_ratio = float(ratio)

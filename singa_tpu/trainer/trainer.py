@@ -52,6 +52,9 @@ class Trainer:
     #: subclasses whose step shape is incompatible with on-device batch
     #: gathering (e.g. the replica trainer's vmap) switch this off
     _allow_device_cache = True
+    #: subclasses that do not thread buffer state (replica/CD trainers)
+    #: reject nets with stateful layers instead of silently dropping them
+    _supports_buffers = True
 
     def __init__(
         self,
@@ -96,8 +99,19 @@ class Trainer:
         self.batch_sh = batch_shardings(self.mesh, self.train_net)
         self._repl = replicated(self.mesh)
 
+        # --- buffers (stateful layers, e.g. batch-norm running stats) ---
+        self._has_buffers = bool(self.train_net.buffer_specs())
+        if self._has_buffers and not self._supports_buffers:
+            raise ConfigError(
+                f"{type(self).__name__} does not support stateful layers "
+                f"(buffers: {sorted(self.train_net.buffer_specs())})"
+            )
+
         # --- params + resume, placed on the mesh ---
         self.start_step = model_cfg.step
+        #: stateful-layer state; base _materialize_params replaces it
+        #: (subclass overrides without buffer support leave it empty)
+        self.buffers: dict = {}
         self._materialize_params()
 
         # --- input pipelines (prefetch thread; base_layer.h:510-537) ---
@@ -148,9 +162,14 @@ class Trainer:
                 self._compute_dtype = dt
 
         # --- the one compiled program ---
-        self._train_step = jax.jit(
-            self._train_step_entry, donate_argnums=(0, 1)
-        )
+        if self._has_buffers:
+            self._train_step = jax.jit(
+                self._train_step_entry_buf, donate_argnums=(0, 1, 2)
+            )
+        else:
+            self._train_step = jax.jit(
+                self._train_step_entry, donate_argnums=(0, 1)
+            )
         # multi-step chunks: scan over the same step body, one dispatch
         # per cadence window instead of per batch (cache keyed by length)
         self._chunk_fns: dict[int, Callable] = {}
@@ -167,9 +186,10 @@ class Trainer:
         everything onto the mesh shardings."""
         params = init_params(self._init_key, self.specs)
         state = self.updater.init_state(params)
+        buffers = self.train_net.init_buffers()
         if self.cfg.checkpoint:
-            ck_step, params, state = restore_into(
-                self.cfg.checkpoint, params, state
+            ck_step, params, state, buffers = restore_into(
+                self.cfg.checkpoint, params, state, buffers
             )
             self.start_step = max(self.start_step, ck_step)
             self.log(
@@ -184,6 +204,9 @@ class Trainer:
                 for s, v in slots.items()
             }
             for n, slots in state.items()
+        }
+        self.buffers = {
+            n: jax.device_put(v, self._repl) for n, v in buffers.items()
         }
 
     # ------------------------------------------------------------------
@@ -253,6 +276,31 @@ class Trainer:
         batch = self._resolve_batch(self.train_net, batch)
         return self._train_step_fn(params, state, step, batch, rng)
 
+    def _train_step_entry_buf(self, params, state, buffers, step, batch, rng):
+        batch = self._resolve_batch(self.train_net, batch)
+        return self._train_step_buf_fn(params, state, buffers, step, batch, rng)
+
+    def _train_step_buf_fn(self, params, state, buffers, step, batch, rng):
+        """Step body for nets with stateful layers: the forward also
+        yields updated buffers (batch-norm running stats) as a has_aux
+        output — plain forward values, outside any gradient path."""
+
+        def loss_fn(p):
+            loss, metrics, new_buffers = self.train_net.forward(
+                self._cast_compute(p), self._cast_compute(batch),
+                training=True, rng=rng,
+                buffers=buffers, return_buffers=True,
+            )
+            return loss, (metrics, new_buffers)
+
+        (_, (metrics, new_buffers)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        params, state = self.updater.apply(
+            step, params, grads, state, self.specs
+        )
+        return params, state, new_buffers, metrics
+
     def _cast_compute(self, tree):
         """Cast float leaves to the compute dtype (bf16 matmuls on the
         MXU); params keep fp32 masters — the cast sits inside loss_fn so
@@ -284,11 +332,11 @@ class Trainer:
     def _eval_step_for(self, net: Net) -> Callable:
         if id(net) not in self._eval_steps:
 
-            def eval_fn(params, batch):
+            def eval_fn(params, buffers, batch):
                 batch = self._resolve_batch(net, batch)
                 _, metrics = net.forward(
                     self._cast_compute(params), self._cast_compute(batch),
-                    training=False,
+                    training=False, buffers=buffers,
                 )
                 return metrics
 
@@ -327,13 +375,17 @@ class Trainer:
         self._last_batch = batch  # debug dumps reuse it (no stream skew)
         rng = jax.random.fold_in(self._step_key, step)
         with self.timers.phase("train"):
-            self.params, self.state, metrics = self._train_step(
-                self.params,
-                self.state,
-                jnp.int32(step),
-                batch,
-                rng,
-            )
+            if self._has_buffers:
+                (self.params, self.state, self.buffers, metrics) = (
+                    self._train_step(
+                        self.params, self.state, self.buffers,
+                        jnp.int32(step), batch, rng,
+                    )
+                )
+            else:
+                self.params, self.state, metrics = self._train_step(
+                    self.params, self.state, jnp.int32(step), batch, rng
+                )
         self.perf.update(metrics)
 
     # ------------------------------------------------------------------
@@ -362,9 +414,9 @@ class Trainer:
         # captured arrays lower to embedded constants, which some runtimes
         # re-upload on every execution (catastrophic through a tunneled
         # device); as an argument it stays resident and is passed by ref
-        def chunk_fn(params, state, step0, pos0s, data):
+        def chunk_fn(params, state, buffers, step0, pos0s, data):
             def body(carry, i):
-                params, state = carry
+                params, state, buffers = carry
                 step = step0 + i
                 batch = {}
                 for name, d in data.items():
@@ -373,21 +425,28 @@ class Trainer:
                     batch[name] = {"__idx__": idx, **d}
                 batch = self._resolve_batch(self.train_net, batch)
                 rng = jax.random.fold_in(self._step_key, step)
-                params, state, metrics = self._train_step_fn(
-                    params, state, step, batch, rng
-                )
-                return (params, state), metrics
+                if self._has_buffers:
+                    params, state, buffers, metrics = (
+                        self._train_step_buf_fn(
+                            params, state, buffers, step, batch, rng
+                        )
+                    )
+                else:
+                    params, state, metrics = self._train_step_fn(
+                        params, state, step, batch, rng
+                    )
+                return (params, state, buffers), metrics
 
-            (params, state), metrics = jax.lax.scan(
-                body, (params, state), jnp.arange(nsteps)
+            (params, state, buffers), metrics = jax.lax.scan(
+                body, (params, state, buffers), jnp.arange(nsteps)
             )
             # sum the per-step metrics inside the program: one dispatch
             # total, no (nsteps,)-stacked metrics round trip
-            return params, state, jax.tree.map(
+            return params, state, buffers, jax.tree.map(
                 lambda a: a.sum(axis=0), metrics
             )
 
-        return jax.jit(chunk_fn, donate_argnums=(0, 1))
+        return jax.jit(chunk_fn, donate_argnums=(0, 1, 2))
 
     def train_chunk(self, step0: int, nsteps: int) -> None:
         """Run nsteps consecutive train steps as ONE compiled program.
@@ -403,9 +462,12 @@ class Trainer:
             name: jnp.int32(pipe.position) for name, pipe in pipes.items()
         }
         with self.timers.phase("train"):
-            self.params, self.state, summed = self._chunk_fns[nsteps](
-                self.params, self.state, jnp.int32(step0), pos0s,
-                self._dev_data[id(self.train_net)],
+            (self.params, self.state, self.buffers, summed) = (
+                self._chunk_fns[nsteps](
+                    self.params, self.state, self.buffers,
+                    jnp.int32(step0), pos0s,
+                    self._dev_data[id(self.train_net)],
+                )
             )
         for name, pipe in pipes.items():
             pipe.advance(nsteps)
@@ -459,7 +521,9 @@ class Trainer:
         eval_params = self._eval_params()
         with self.timers.phase("eval"):
             for _ in range(nsteps):
-                perf.update(fn(eval_params, self._next_batch(net)))
+                perf.update(
+                    fn(eval_params, self.buffers, self._next_batch(net))
+                )
         avg = perf.avg()
         self.log(f"step {step}: {phase} {perf.to_string()}")
         return avg
@@ -553,7 +617,7 @@ class Trainer:
         if folder is None:
             return None
         path = os.path.join(folder, f"step_{step}.npz")
-        save_checkpoint(path, step, self.params, self.state)
+        save_checkpoint(path, step, self.params, self.state, self.buffers)
         self.log(f"step {step}: checkpoint -> {path}")
         return path
 
@@ -567,7 +631,8 @@ class Trainer:
         )
         rng = jax.random.fold_in(self._step_key, step)
         _, _, acts = self.train_net.forward(
-            self.params, batch, training=True, rng=rng, return_acts=True
+            self.params, batch, training=True, rng=rng,
+            buffers=self.buffers, return_acts=True,
         )
         lines = [
             "debug: "

@@ -167,7 +167,7 @@ def test_checkpoint_resume_reproduces_uninterrupted_run(tmp_path):
     t_b.run()
     ckpt = os.path.join(cluster.workspace, "checkpoints", "step_10.npz")
     assert os.path.exists(ckpt)
-    step, params, state = load_checkpoint(ckpt)
+    step, params, state, _ = load_checkpoint(ckpt)
     assert step == 10
     assert set(params) == set(t_a.params)
 
