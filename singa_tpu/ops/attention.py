@@ -116,11 +116,16 @@ except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_k):
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k, seq_k
+):
     """One (batch*head, q-block) program: stream K/V blocks via VMEM.
 
-    Refs are (1, Bq, D) for q/o and (1, Sk, D) for k/v; accumulation in
-    fp32 registers/VMEM values (flash statistics never touch HBM).
+    Refs are (1, Bq, D) for q/o, (1, Sk, D) for k/v, (1, 1, Bq) for the
+    log-sum-exp rows (the backward kernels' softmax residual; the lse
+    array is laid out (BH, 1, S) so every block index is static and
+    lane-aligned — Mosaic rejects dynamic sublane loads); accumulation
+    in fp32 registers/VMEM values (flash statistics never touch HBM).
     """
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
@@ -147,26 +152,112 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_k):
     else:
         out, m, l = jax.lax.fori_loop(0, nblocks, body, (out, m, l))
     o_ref[0] = block_attn_finish(out, m, l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+    *, causal, block_k, seq_k, scale,
+):
+    """dQ for one (batch*head, q-block): stream K/V blocks.
+
+    FlashAttention backward recurrences: P = exp(S - lse),
+    dS = P * (dO V^T - D) with D = rowsum(dO * O), dQ = dS K * scale.
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    bq, d = q.shape
+    delta = jnp.sum(do * o, axis=-1)  # D, (Bq,)
+    q_offset = qi * bq
+    dq = jnp.zeros((bq, d), dtype=jnp.float32)
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * scale
+        if causal:
+            qpos = q_offset + jnp.arange(bq)
+            kpos = i * block_k + jnp.arange(block_k)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        ds = p * (do @ v.T - delta[:, None])
+        return dq + (ds @ k) * scale
+
+    nblocks = seq_k // block_k
+    if causal:
+        nlive = jax.lax.div(q_offset + bq - 1, block_k) + 1
+        dq = jax.lax.fori_loop(0, nlive, body, dq)
+    else:
+        dq = jax.lax.fori_loop(0, nblocks, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
+    *, causal, block_q, seq_q, scale,
+):
+    """dK/dV for one (batch*head, k-block): stream Q/dO/O blocks.
+
+    dV = P^T dO; dK = (P * (dO V^T - D))^T Q * scale.
+    """
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    k_offset = ki * bk
+    dk = jnp.zeros((bk, d), dtype=jnp.float32)
+    dv = jnp.zeros((bk, d), dtype=jnp.float32)
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        s = (q @ k.T) * scale
+        if causal:
+            qpos = j * block_q + jnp.arange(block_q)
+            kpos = k_offset + jnp.arange(bk)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (Bq, Bk)
+        delta = jnp.sum(do * o, axis=-1)
+        ds = p * (do @ v.T - delta[:, None])
+        return dk + (ds.T @ q) * scale, dv + p.T @ do
+
+    nblocks = seq_q // block_q
+    if causal:
+        # q blocks strictly above this k block's diagonal see only masked
+        # scores; start at the first contributing block
+        first = jax.lax.div(k_offset, block_q)
+        dk, dv = jax.lax.fori_loop(first, nblocks, body, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(0, nblocks, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q, k, v, causal=False, block_q=128, block_k=128, interpret=None
 ):
-    """Flash attention: Pallas forward, reference-math backward.
+    """Flash attention: Pallas forward AND backward.
 
     Falls back to the dense reference when Pallas is unavailable, the
     sequence does not tile evenly, or Sq != Sk. ``interpret=True`` runs
-    the kernel in the Pallas interpreter (CPU testing); default
+    the kernels in the Pallas interpreter (CPU testing); default
     auto-detects TPU.
 
-    NOTE: the backward pass recomputes through the dense reference, so
-    it materializes the S x S score matrix — training peak memory is the
-    dense peak. For long-context *training*, shard the sequence with
-    ring attention (singa_tpu/parallel/ring.py) instead; this kernel's
-    win is forward/inference memory and fusion.
+    Training memory is O(S) per head row (out + lse residuals) instead
+    of the dense O(S^2): the backward recomputes P blockwise from
+    (q, k, v, lse) inside its own kernels.
     """
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _use_kernel(q, k, block_q, block_k, interpret):
@@ -177,14 +268,20 @@ def _use_kernel(q, k, block_q, block_k, interpret):
         return False
     if s % block_q or s % block_k:
         return False
+    if not interpret and block_q % 128:
+        # on real hardware the lse lane dimension is blocked by block_q,
+        # and Mosaic requires lane blocks in multiples of 128 (the
+        # interpreter is laxer — tests exercise smaller geometries there)
+        return False
     if interpret is None:
         return jax.default_backend() == "tpu"
     return True
 
 
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    """-> (out, lse | None); lse None means the dense fallback ran."""
     if not _use_kernel(q, k, block_q, block_k, interpret):
-        return attention(q, k, v, causal=causal)
+        return attention(q, k, v, causal=causal), None
     b, h, s, d = q.shape
     bh = b * h
     qf = q.reshape(bh, s, d)
@@ -193,7 +290,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_k=block_k, seq_k=s
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, s // block_q),
         in_specs=[
@@ -201,25 +298,69 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
         interpret=bool(interpret),
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    """Backward through the dense reference math (recompute): exact
-    gradients, O(S^2) flops like any attention backward, no extra
-    forward residuals kept in HBM."""
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if lse is None:
+        # dense fallback path: recompute through the reference math
+        _, vjp = jax.vjp(
+            lambda q, k, v: attention(q, k, v, causal=causal), q, k, v
+        )
+        return vjp(g)
+    b, h, s, d = q.shape
+    bh = b * h
+    scale = 1.0 / math.sqrt(d)
+    flat = lambda x: x.reshape(bh, s, d)  # noqa: E731
+    args = (flat(q), flat(k), flat(v), flat(g), flat(out), lse)
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
+    full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
+    lse_blk = pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j))
+    lse_full = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            causal=causal, block_k=block_k, seq_k=s, scale=scale,
+        ),
+        grid=(bh, s // block_q),
+        in_specs=[qspec, full, full, qspec, qspec, lse_blk],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=bool(interpret),
+    )(*args)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            causal=causal, block_q=block_q, seq_q=s, scale=scale,
+        ),
+        grid=(bh, s // block_k),
+        in_specs=[full, kspec, kspec, full, full, lse_full],
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=bool(interpret),
+    )(*args)
+    unflat = lambda x: x.reshape(b, h, s, d)  # noqa: E731
+    return unflat(dq), unflat(dk), unflat(dv)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

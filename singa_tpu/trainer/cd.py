@@ -78,7 +78,7 @@ class CDTrainer(Trainer):
 
     # ------------------------------------------------------------------
 
-    def _train_step_fn(self, params, state, step, batch, rng):
+    def _train_step_fn(self, params, state, buffers, step, batch, rng):
         """One jitted CD step: walk the net through Net.forward (keeping
         its shared-param and connector invariants), swapping each RBM's
         compute for a Gibbs-chain update; then push the collected CD grads
@@ -105,7 +105,7 @@ class CDTrainer(Trainer):
         )
         params = {**params, **new_p}
         state = {**state, **new_s}
-        return params, state, metrics
+        return params, state, buffers, metrics
 
     def _eval_step_for(self, net):
         """Eval metric per RBM: mean-field reconstruction error."""

@@ -155,11 +155,12 @@ class ReplicaTrainer(Trainer):
     # compiled steps
     # ------------------------------------------------------------------
 
-    def _train_step_fn(self, params, state, step, batch, rng):
+    def _train_step_fn(self, params, state, buffers, step, batch, rng):
         """vmap the per-replica forward/backward/update over the leading
         replica axis; metrics are averaged across replicas (each group
         reports its own Performance in the reference — one average is the
-        honest aggregate)."""
+        honest aggregate). ``buffers`` passes through untouched — replica
+        nets reject stateful layers (_supports_buffers)."""
         rngs = jax.random.split(rng, self.nreplicas)
 
         def one(p, s, b, r):
@@ -177,7 +178,7 @@ class ReplicaTrainer(Trainer):
             one, in_axes=(0, 0, 0, 0)
         )(params, state, batch, rngs)
         metrics = jax.tree.map(lambda x: jnp.mean(x, axis=0), metrics)
-        return params, state, metrics
+        return params, state, buffers, metrics
 
     def _build_sync(self):
         if self.protocol == "Elastic":

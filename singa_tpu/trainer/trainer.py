@@ -162,14 +162,9 @@ class Trainer:
                 self._compute_dtype = dt
 
         # --- the one compiled program ---
-        if self._has_buffers:
-            self._train_step = jax.jit(
-                self._train_step_entry_buf, donate_argnums=(0, 1, 2)
-            )
-        else:
-            self._train_step = jax.jit(
-                self._train_step_entry, donate_argnums=(0, 1)
-            )
+        self._train_step = jax.jit(
+            self._train_step_entry, donate_argnums=(0, 1, 2)
+        )
         # multi-step chunks: scan over the same step body, one dispatch
         # per cadence window instead of per batch (cache keyed by length)
         self._chunk_fns: dict[int, Callable] = {}
@@ -270,20 +265,31 @@ class Trainer:
     # compiled step functions
     # ------------------------------------------------------------------
 
-    def _train_step_entry(self, params, state, step, batch, rng):
+    def _train_step_entry(self, params, state, buffers, step, batch, rng):
         """Jit entry: resolve cached batches, then run the (possibly
-        subclass-overridden) step body."""
+        subclass-overridden) step body. Buffers always thread through —
+        an empty dict for stateless nets costs nothing."""
         batch = self._resolve_batch(self.train_net, batch)
-        return self._train_step_fn(params, state, step, batch, rng)
+        return self._train_step_fn(params, state, buffers, step, batch, rng)
 
-    def _train_step_entry_buf(self, params, state, buffers, step, batch, rng):
-        batch = self._resolve_batch(self.train_net, batch)
-        return self._train_step_buf_fn(params, state, buffers, step, batch, rng)
+    def _cast_compute(self, tree):
+        """Cast float leaves to the compute dtype (bf16 matmuls on the
+        MXU); params keep fp32 masters — the cast sits inside loss_fn so
+        its transpose upcasts the grads back to fp32 automatically."""
+        if self._compute_dtype is None:
+            return tree
+        dt = self._compute_dtype
+        return jax.tree.map(
+            lambda x: x.astype(dt)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
 
-    def _train_step_buf_fn(self, params, state, buffers, step, batch, rng):
-        """Step body for nets with stateful layers: the forward also
-        yields updated buffers (batch-norm running stats) as a has_aux
-        output — plain forward values, outside any gradient path."""
+    def _train_step_fn(self, params, state, buffers, step, batch, rng):
+        """One forward+backward+update. Stateful layers' buffer updates
+        (batch-norm running stats) ride the has_aux output — plain
+        forward values, outside any gradient path."""
 
         def loss_fn(p):
             loss, metrics, new_buffers = self.train_net.forward(
@@ -300,34 +306,6 @@ class Trainer:
             step, params, grads, state, self.specs
         )
         return params, state, new_buffers, metrics
-
-    def _cast_compute(self, tree):
-        """Cast float leaves to the compute dtype (bf16 matmuls on the
-        MXU); params keep fp32 masters — the cast sits inside loss_fn so
-        its transpose upcasts the grads back to fp32 automatically."""
-        if self._compute_dtype is None:
-            return tree
-        dt = self._compute_dtype
-        return jax.tree.map(
-            lambda x: x.astype(dt)
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-            else x,
-            tree,
-        )
-
-    def _train_step_fn(self, params, state, step, batch, rng):
-        def loss_fn(p):
-            loss, metrics = self.train_net.forward(
-                self._cast_compute(p), self._cast_compute(batch),
-                training=True, rng=rng,
-            )
-            return loss, metrics
-
-        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        params, state = self.updater.apply(
-            step, params, grads, state, self.specs
-        )
-        return params, state, metrics
 
     def _eval_step_for(self, net: Net) -> Callable:
         if id(net) not in self._eval_steps:
@@ -375,17 +353,12 @@ class Trainer:
         self._last_batch = batch  # debug dumps reuse it (no stream skew)
         rng = jax.random.fold_in(self._step_key, step)
         with self.timers.phase("train"):
-            if self._has_buffers:
-                (self.params, self.state, self.buffers, metrics) = (
-                    self._train_step(
-                        self.params, self.state, self.buffers,
-                        jnp.int32(step), batch, rng,
-                    )
+            (self.params, self.state, self.buffers, metrics) = (
+                self._train_step(
+                    self.params, self.state, self.buffers,
+                    jnp.int32(step), batch, rng,
                 )
-            else:
-                self.params, self.state, metrics = self._train_step(
-                    self.params, self.state, jnp.int32(step), batch, rng
-                )
+            )
         self.perf.update(metrics)
 
     # ------------------------------------------------------------------
@@ -425,16 +398,9 @@ class Trainer:
                     batch[name] = {"__idx__": idx, **d}
                 batch = self._resolve_batch(self.train_net, batch)
                 rng = jax.random.fold_in(self._step_key, step)
-                if self._has_buffers:
-                    params, state, buffers, metrics = (
-                        self._train_step_buf_fn(
-                            params, state, buffers, step, batch, rng
-                        )
-                    )
-                else:
-                    params, state, metrics = self._train_step_fn(
-                        params, state, step, batch, rng
-                    )
+                params, state, buffers, metrics = self._train_step_fn(
+                    params, state, buffers, step, batch, rng
+                )
                 return (params, state, buffers), metrics
 
             (params, state, buffers), metrics = jax.lax.scan(
