@@ -18,6 +18,8 @@ Package map (reference layer in parens, see SURVEY.md):
   parallel/  mesh, shardings, collectives      (cluster/router/bridges, L7)
   trainer/   training loop, cadences, ckpt     (src/worker/worker.cc, L5)
   models/    model family builders             (examples/, L9)
+  tools/     sweep, plots, partitioner, dot    (script/, batch.sh, L9)
+  native/    C++ shard/record codec            (src/utils/shard.cc, L1)
   utils/     metrics, timers, graph viz        (L9)
 """
 
