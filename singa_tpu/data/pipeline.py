@@ -61,9 +61,18 @@ def load_shard_arrays(folder: str) -> tuple[np.ndarray, np.ndarray]:
 def load_lmdb_arrays(path: str) -> tuple[np.ndarray, np.ndarray]:
     """Decode every Datum in a Caffe LMDB into (images, labels) arrays,
     the in-memory equivalent of LMDBDataLayer's cursor loop + conversion
-    (reference layer.cc:237-328)."""
-    from .lmdbio import LMDBReader
+    (reference layer.cc:237-328).
+
+    Uniform-geometry databases decode through the native C++ walker when
+    built (singa_tpu.native, like the reference's liblmdb path); anything
+    it declines falls back to the pure-Python B+tree reader."""
+    from .. import native
+    from .lmdbio import LMDBReader, lmdb_data_path
     from .records import datum_to_image_record, decode_datum
+
+    fast = native.load_lmdb_dataset(lmdb_data_path(path))
+    if fast is not None:
+        return fast
 
     images: list[np.ndarray] = []
     labels: list[int] = []

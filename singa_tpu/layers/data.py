@@ -109,6 +109,10 @@ class MnistImageLayer(Layer):
         import jax
 
         x = inputs[0]["image"].astype(jnp.float32)
+        if x.ndim == 4 and x.shape[1] == 1:
+            # LMDB datums carry an explicit C=1 dim; records from idx
+            # files don't — normalize to (N, H, W) as setup declared
+            x = x[:, 0]
         if self.resize != x.shape[-1]:
             x = jax.image.resize(
                 x, (*x.shape[:-2], self.resize, self.resize), "linear"

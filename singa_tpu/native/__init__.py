@@ -23,15 +23,19 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "shardcodec.cc")
 _LIB = os.path.join(_DIR, "libshardcodec.so")
+_LMDB_SRC = os.path.join(_DIR, "lmdbcodec.cc")
+_LMDB_LIB = os.path.join(_DIR, "liblmdbcodec.so")
 
 _lib: ctypes.CDLL | None = None
 _tried = False
+_lmdb_lib: ctypes.CDLL | None = None
+_lmdb_tried = False
 
 
-def _build() -> bool:
+def _build(src: str, lib: str) -> bool:
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", lib, src],
             check=True,
             capture_output=True,
             timeout=120,
@@ -41,20 +45,27 @@ def _build() -> bool:
         return False
 
 
+def _load(src: str, lib_path: str) -> ctypes.CDLL | None:
+    """Build (if stale) + dlopen one codec library; None if unavailable."""
+    if not os.path.exists(lib_path) or os.path.getmtime(
+        lib_path
+    ) < os.path.getmtime(src):
+        if not _build(src, lib_path):
+            return None
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+
+
 def get_lib() -> ctypes.CDLL | None:
-    """Load (building if needed) the codec; None if unavailable."""
+    """Load (building if needed) the shard codec; None if unavailable."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(
-        _SRC
-    ):
-        if not _build():
-            return None
-    try:
-        lib = ctypes.CDLL(_LIB)
-    except OSError:
+    lib = _load(_SRC, _LIB)
+    if lib is None:
         return None
     lib.sc_scan.restype = ctypes.c_int64
     lib.sc_scan.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
@@ -82,6 +93,58 @@ def get_lib() -> ctypes.CDLL | None:
     ]
     _lib = lib
     return _lib
+
+
+def get_lmdb_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the LMDB codec; None if unavailable."""
+    global _lmdb_lib, _lmdb_tried
+    if _lmdb_lib is not None or _lmdb_tried:
+        return _lmdb_lib
+    _lmdb_tried = True
+    lib = _load(_LMDB_SRC, _LMDB_LIB)
+    if lib is None:
+        return None
+    lib.lc_load_dataset.restype = ctypes.c_int64
+    lib.lc_load_dataset.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.lc_free_result.restype = None
+    lib.lc_free_result.argtypes = [ctypes.c_void_p]
+    _lmdb_lib = lib
+    return _lmdb_lib
+
+
+def load_lmdb_dataset(path: str) -> tuple[np.ndarray, np.ndarray] | None:
+    """Walk + decode a whole Caffe LMDB natively (the reference's
+    liblmdb/libprotobuf path, layer.cc:237-328). -> (images float32
+    (N, C, H, W), labels int32 (N,)), or None when the native path can't
+    serve it (falls back to singa_tpu.data.lmdbio — e.g. mixed per-record
+    geometry, dupsort databases, or no toolchain)."""
+    lib = get_lmdb_lib()
+    if lib is None:
+        return None
+    handle = ctypes.c_void_p()
+    pixels_p = ctypes.POINTER(ctypes.c_float)()
+    labels_p = ctypes.POINTER(ctypes.c_int32)()
+    shape_buf = (ctypes.c_int32 * 3)()
+    count = lib.lc_load_dataset(
+        path.encode(), ctypes.byref(handle), ctypes.byref(pixels_p),
+        ctypes.byref(labels_p), shape_buf,
+    )
+    if count <= 0:
+        return None
+    try:
+        shape = tuple(shape_buf[i] for i in range(3))
+        sample = int(np.prod(shape))
+        images = np.ctypeslib.as_array(pixels_p, (int(count), sample)).copy()
+        labels = np.ctypeslib.as_array(labels_p, (int(count),)).copy()
+    finally:
+        lib.lc_free_result(handle)
+    return images.reshape((int(count), *shape)), labels
 
 
 def available() -> bool:

@@ -1,0 +1,144 @@
+"""Native C++ LMDB walker vs the pure-Python codec.
+
+Both decode the same databases into identical arrays; the native path
+declines (returns None) anything outside its uniform-geometry contract
+and the Python reader takes over."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import native
+from singa_tpu.data.lmdbio import write_lmdb
+from singa_tpu.data.loader import shard_to_lmdb, synthetic_arrays, write_records
+from singa_tpu.data.pipeline import load_lmdb_arrays
+from singa_tpu.data.records import Datum, encode_datum
+
+pytestmark = pytest.mark.skipif(
+    native.get_lmdb_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def _python_arrays(path):
+    """Force the pure-Python path for comparison."""
+    from singa_tpu.data.lmdbio import LMDBReader
+    from singa_tpu.data.records import datum_to_image_record, decode_datum
+
+    images, labels = [], []
+    with LMDBReader(path) as r:
+        for _, val in r:
+            rec = datum_to_image_record(decode_datum(val))
+            img = (
+                np.frombuffer(rec.pixel, dtype=np.uint8).astype(np.float32)
+                if rec.pixel
+                else np.asarray(rec.data, dtype=np.float32)
+            )
+            images.append(img.reshape(rec.shape))
+            labels.append(rec.label)
+    return np.stack(images), np.asarray(labels, dtype=np.int32)
+
+
+def test_native_matches_python_uint8(tmp_path):
+    imgs, labs = synthetic_arrays(40, seed=5)
+    shard = str(tmp_path / "shard")
+    write_records(shard, imgs, labs)
+    db = str(tmp_path / "db")
+    shard_to_lmdb(shard, db)
+    got = native.load_lmdb_dataset(str(tmp_path / "db" / "data.mdb"))
+    assert got is not None
+    ni, nl = got
+    pi, pl = _python_arrays(db)
+    np.testing.assert_array_equal(ni, pi)
+    np.testing.assert_array_equal(nl, pl)
+    assert ni.dtype == np.float32 and nl.dtype == np.int32
+    assert ni.shape == (40, 1, 28, 28)
+
+
+def test_native_float_datums(tmp_path):
+    items = []
+    rng = np.random.RandomState(0)
+    vals = rng.randn(6, 2, 3, 4).astype(np.float32)
+    for i in range(6):
+        d = Datum(channels=2, height=3, width=4, label=i,
+                  float_data=[float(x) for x in vals[i].ravel()])
+        items.append((f"{i:08d}".encode(), encode_datum(d)))
+    db = str(tmp_path / "db")
+    write_lmdb(db, items)
+    got = native.load_lmdb_dataset(str(tmp_path / "db" / "data.mdb"))
+    assert got is not None
+    ni, nl = got
+    np.testing.assert_allclose(ni, vals)
+    assert list(nl) == list(range(6))
+
+
+def test_native_overflow_values(tmp_path):
+    """Datums big enough for overflow chains decode correctly."""
+    n, c, h, w = 5, 3, 40, 40  # 4800B payload > nodemax
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, size=(n, c, h, w)).astype(np.uint8)
+    items = [
+        (f"{i:08d}".encode(),
+         encode_datum(Datum(channels=c, height=h, width=w,
+                            data=imgs[i].tobytes(), label=i)))
+        for i in range(n)
+    ]
+    db = str(tmp_path / "db")
+    write_lmdb(db, items)
+    ni, nl = native.load_lmdb_dataset(str(tmp_path / "db" / "data.mdb"))
+    np.testing.assert_array_equal(ni, imgs.astype(np.float32))
+
+
+def test_native_declines_mixed_geometry(tmp_path):
+    items = [
+        (b"a", encode_datum(Datum(channels=1, height=2, width=2,
+                                  data=bytes(4)))),
+        (b"b", encode_datum(Datum(channels=1, height=3, width=3,
+                                  data=bytes(9)))),
+    ]
+    db = str(tmp_path / "db")
+    write_lmdb(db, items)
+    assert native.load_lmdb_dataset(str(tmp_path / "db" / "data.mdb")) is None
+
+
+def test_native_declines_garbage(tmp_path):
+    p = tmp_path / "junk.mdb"
+    p.write_bytes(b"\xff" * 8192)
+    assert native.load_lmdb_dataset(str(p)) is None
+
+
+def test_pipeline_routes_through_native(tmp_path, monkeypatch):
+    imgs, labs = synthetic_arrays(16, seed=7)
+    shard = str(tmp_path / "shard")
+    write_records(shard, imgs, labs)
+    db = str(tmp_path / "db")
+    shard_to_lmdb(shard, db)
+    calls = []
+    orig = native.load_lmdb_dataset
+
+    def spy(path):
+        calls.append(path)
+        return orig(path)
+
+    monkeypatch.setattr(native, "load_lmdb_dataset", spy)
+    images, labels = load_lmdb_arrays(db)
+    assert calls, "pipeline skipped the native path"
+    np.testing.assert_array_equal(labels, labs)
+    np.testing.assert_array_equal(
+        images.reshape(16, 28, 28), imgs.astype(np.float32)
+    )
+
+
+def test_native_multilevel_tree(tmp_path):
+    """Enough records to force branch pages."""
+    n = 3000
+    items = [
+        (f"{i:08d}".encode(),
+         encode_datum(Datum(channels=1, height=2, width=2,
+                            data=bytes([i % 251] * 4), label=i % 10)))
+        for i in range(n)
+    ]
+    db = str(tmp_path / "db")
+    write_lmdb(db, items)
+    ni, nl = native.load_lmdb_dataset(str(tmp_path / "db" / "data.mdb"))
+    assert len(ni) == n
+    assert ni[1234][0][0][0] == float(1234 % 251)
+    assert nl[1234] == 1234 % 10
