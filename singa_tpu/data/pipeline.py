@@ -64,8 +64,10 @@ def load_lmdb_arrays(path: str) -> tuple[np.ndarray, np.ndarray]:
     (reference layer.cc:237-328).
 
     Uniform-geometry databases decode through the native C++ walker when
-    built (singa_tpu.native, like the reference's liblmdb path); anything
-    it declines falls back to the pure-Python B+tree reader."""
+    built (singa_tpu.native, like the reference's liblmdb path); a missing
+    toolchain or unsupported database feature falls back to the
+    pure-Python B+tree reader. Mixed per-record geometry cannot be
+    batched by either path and raises a clear error."""
     from .. import native
     from .lmdbio import LMDBReader, lmdb_data_path
     from .records import datum_to_image_record, decode_datum
@@ -76,10 +78,19 @@ def load_lmdb_arrays(path: str) -> tuple[np.ndarray, np.ndarray]:
 
     images: list[np.ndarray] = []
     labels: list[int] = []
+    first_shape: tuple | None = None
     with LMDBReader(path) as reader:
-        for _, val in reader:
+        for key, val in reader:
             rec = datum_to_image_record(decode_datum(val))
             shape = tuple(rec.shape) if any(rec.shape) else (-1,)
+            if first_shape is None:
+                first_shape = shape
+            elif shape != first_shape:
+                raise ValueError(
+                    f"LMDB {path!r}: record {key!r} has shape {shape}, "
+                    f"others {first_shape} — mixed geometry cannot be "
+                    "batched; re-export at a uniform size"
+                )
             if rec.pixel:
                 img = np.frombuffer(rec.pixel, dtype=np.uint8).astype(
                     np.float32

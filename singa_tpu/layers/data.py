@@ -96,9 +96,14 @@ class MnistImageLayer(Layer):
         self.alpha = p.alpha if p else 0.0
         self.beta = p.beta if p else 0.0
         self.gamma = p.gamma if p else 0.0
-        src = src_shapes[0]  # the data layer's (batch, H, W)
+        src = src_shapes[0]  # the data layer's (batch, H, W) or (b,1,H,W)
         if len(src) < 3:
             raise ConfigError(f"layer {self.name!r}: expects image records")
+        if len(src) == 4 and src[1] != 1:
+            raise ConfigError(
+                f"layer {self.name!r}: kMnistImage is single-channel; got "
+                f"C={src[1]} records (use kRGBImage)"
+            )
         size = src[-1]
         if src[-2] != size:
             raise ConfigError(f"layer {self.name!r}: MNIST images must be square")

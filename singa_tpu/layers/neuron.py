@@ -97,8 +97,11 @@ class InnerProductLayer(Layer):
         return (src[0], self.hdim)
 
     def apply(self, params, inputs, *, training, rng=None):
-        x = inputs[0].reshape(inputs[0].shape[0], -1)
-        out = x @ params[self.wname]
+        w = params[self.wname]
+        # align to the weight dtype (bf16 under compute_dtype) so the
+        # matmul doesn't silently promote back to fp32
+        x = inputs[0].reshape(inputs[0].shape[0], -1).astype(w.dtype)
+        out = x @ w
         if self.bias_term:
             out = out + params[self.bname]
         return out

@@ -122,8 +122,9 @@ def load_lmdb_dataset(path: str) -> tuple[np.ndarray, np.ndarray] | None:
     """Walk + decode a whole Caffe LMDB natively (the reference's
     liblmdb/libprotobuf path, layer.cc:237-328). -> (images float32
     (N, C, H, W), labels int32 (N,)), or None when the native path can't
-    serve it (falls back to singa_tpu.data.lmdbio — e.g. mixed per-record
-    geometry, dupsort databases, or no toolchain)."""
+    serve it — the caller falls back to singa_tpu.data.lmdbio, which
+    either decodes (dupsort-free DBs, no toolchain needed) or raises the
+    descriptive error (mixed per-record geometry)."""
     lib = get_lmdb_lib()
     if lib is None:
         return None

@@ -210,7 +210,8 @@ struct Walker {
       shape[2] = d.width;
     }
     if (n != sample) {
-      rc = -5;  // mixed geometry: Python path handles it
+      rc = -5;  // mixed geometry: nothing can batch it; the Python
+                // fallback raises the descriptive error
       return false;
     }
     size_t old = pixels.size();

@@ -22,7 +22,7 @@ def conv2d(
     *,
     stride: int = 1,
     pad: int = 0,
-    precision=lax.Precision.HIGHEST,
+    precision=None,
 ) -> jnp.ndarray:
     """2-D convolution over NCHW input.
 
@@ -32,15 +32,27 @@ def conv2d(
     layout — or as (F, C, k, k). mshadow's unpack_patch2col row ordering is
     (c, kh, kw) row-major, so the reshape is exactly OIHW.
 
-    ``precision`` defaults to HIGHEST because the reference accumulates in
-    fp32 (cblas_sgemm); pass ``lax.Precision.DEFAULT`` (bf16 MXU passes) on
-    the perf path when parity tolerances allow.
+    ``precision=None`` resolves by weight dtype: HIGHEST for fp32 (the
+    reference accumulates in fp32, cblas_sgemm) and DEFAULT for bf16
+    weights (compute_dtype's single-pass MXU mode — HIGHEST would
+    multi-pass bf16 back to fp32 cost). An explicit precision always
+    wins.
     """
     if weight.ndim == 2:
         nf = weight.shape[0]
         c = x.shape[1]
         k = int(round((weight.shape[1] // c) ** 0.5))
         weight = weight.reshape(nf, c, k, k)
+    # mixed precision engages here: under compute_dtype the weights are
+    # bf16 while parser-produced activations are fp32 — align to the
+    # weight dtype so the MXU sees a true bf16 conv
+    x = x.astype(weight.dtype)
+    if precision is None:
+        precision = (
+            lax.Precision.DEFAULT
+            if weight.dtype == jnp.bfloat16
+            else lax.Precision.HIGHEST
+        )
     out = lax.conv_general_dilated(
         x,
         weight,

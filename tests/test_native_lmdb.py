@@ -19,22 +19,11 @@ pytestmark = pytest.mark.skipif(
 
 
 def _python_arrays(path):
-    """Force the pure-Python path for comparison."""
-    from singa_tpu.data.lmdbio import LMDBReader
-    from singa_tpu.data.records import datum_to_image_record, decode_datum
+    """Run the production fallback (native path disabled) for comparison."""
+    from unittest import mock
 
-    images, labels = [], []
-    with LMDBReader(path) as r:
-        for _, val in r:
-            rec = datum_to_image_record(decode_datum(val))
-            img = (
-                np.frombuffer(rec.pixel, dtype=np.uint8).astype(np.float32)
-                if rec.pixel
-                else np.asarray(rec.data, dtype=np.float32)
-            )
-            images.append(img.reshape(rec.shape))
-            labels.append(rec.label)
-    return np.stack(images), np.asarray(labels, dtype=np.int32)
+    with mock.patch.object(native, "load_lmdb_dataset", lambda p: None):
+        return load_lmdb_arrays(path)
 
 
 def test_native_matches_python_uint8(tmp_path):
@@ -97,6 +86,9 @@ def test_native_declines_mixed_geometry(tmp_path):
     db = str(tmp_path / "db")
     write_lmdb(db, items)
     assert native.load_lmdb_dataset(str(tmp_path / "db" / "data.mdb")) is None
+    # ...and the pipeline turns the decline into a descriptive error
+    with pytest.raises(ValueError, match="mixed geometry"):
+        load_lmdb_arrays(db)
 
 
 def test_native_declines_garbage(tmp_path):
