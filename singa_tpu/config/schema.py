@@ -222,6 +222,43 @@ class SplitConfig(Message):
     FIELDS = {"num_splits": Field("int")}
 
 
+class EmbeddingConfig(Message):
+    """singa-tpu extension: token + learned positional embedding
+    (layers/sequence.py). The reference predates sequence models."""
+
+    FIELDS = {
+        "vocab_size": Field("int", required=True),
+        "embedding_dim": Field("int", required=True),
+        "max_len": Field("int", 0),  # 0 = the data layer's seq length
+    }
+
+
+class LayerNormConfig(Message):
+    FIELDS = {"eps": Field("float", 1e-5)}
+
+
+class AttentionConfig(Message):
+    """Causal multi-head self-attention over (B, S, D) activations.
+    mode "flash" runs the Pallas kernel on TPU (dense fallback where the
+    kernel can't serve the geometry)."""
+
+    FIELDS = {
+        "num_heads": Field("int", required=True),
+        "mode": Field("enum", "dense", enum=("dense", "flash")),
+    }
+
+
+class DenseConfig(Message):
+    """Per-position (last-dim) linear map — unlike kInnerProduct, which
+    flattens to (batch, -1). Optional fused activation."""
+
+    FIELDS = {
+        "num_output": Field("int", required=True),
+        "activation": Field("enum", "", enum=("", "gelu", "relu")),
+        "bias_term": Field("bool", True),
+    }
+
+
 class GlobalPoolingConfig(Message):
     """singa-tpu extension: kGlobalPooling has no kernel/stride — only the
     method (AVE default, the ResNet convention)."""
@@ -386,6 +423,10 @@ class LayerConfig(Message):
         "exclude": Field("enum", repeated=True, enum=PHASES),
         "batchnorm_param": Field("message", message=BatchNormConfig),
         "globalpooling_param": Field("message", message=GlobalPoolingConfig),
+        "embedding_param": Field("message", message=EmbeddingConfig),
+        "layernorm_param": Field("message", message=LayerNormConfig),
+        "attention_param": Field("message", message=AttentionConfig),
+        "dense_param": Field("message", message=DenseConfig),
         "convolution_param": Field("message", message=ConvolutionConfig),
         "concate_param": Field("message", message=ConcateConfig),
         "data_param": Field("message", message=DataConfig),

@@ -271,6 +271,47 @@ def split_shard(input_dir: str, prefix: str, n: int, mode: str = "equal"):
                 w.flush()
 
 
+def text_token_arrays(
+    path: str, seq_len: int, stride: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Byte-level LM dataset from any text/binary file: overlapping
+    fixed-length windows of raw bytes (vocab 256). Labels are unused (the
+    kLMLoss target is the sequence itself)."""
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), dtype=np.uint8)
+    if len(raw) < seq_len + 1:
+        raise ValueError(f"{path}: shorter than one {seq_len}-byte window")
+    stride = stride or seq_len
+    # inclusive stop: the window starting at len-seq_len is valid (kLMLoss
+    # targets are within-window)
+    starts = np.arange(0, len(raw) - seq_len + 1, stride)
+    tokens = np.stack([raw[s : s + seq_len] for s in starts])
+    return tokens, np.zeros(len(tokens), dtype=np.uint8)
+
+
+def synthetic_token_arrays(
+    n: int, seq_len: int = 128, vocab: int = 64, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Learnable synthetic sequences: a fixed random Markov chain over
+    ``vocab`` symbols (deterministic given seed) — next-token accuracy
+    well above chance is reachable, so LM convergence tests mean
+    something."""
+    if not 2 <= vocab <= 256:
+        raise ValueError(
+            f"vocab must be in [2, 256] (uint8 token records), got {vocab}"
+        )
+    rng = np.random.RandomState(seed)
+    # each symbol strongly prefers one successor (80%), else uniform
+    succ = rng.randint(0, vocab, size=vocab)
+    seqs = np.empty((n, seq_len), dtype=np.uint8)
+    state = rng.randint(0, vocab, size=n)
+    for t in range(seq_len):
+        seqs[:, t] = state
+        follow = rng.rand(n) < 0.8
+        state = np.where(follow, succ[state], rng.randint(0, vocab, size=n))
+    return seqs, np.zeros(n, dtype=np.uint8)
+
+
 # ------------------- LMDB interop (reference kLMDBData) -------------------
 
 
@@ -352,6 +393,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--channels", type=int, default=0)
 
+    p = sub.add_parser("text")
+    p.add_argument("--input", required=True, help="any text/binary file")
+    p.add_argument("--output", required=True)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--stride", type=int, default=0,
+                   help="window stride (default seq-len, non-overlapping)")
+
+    p = sub.add_parser("tokens")
+    p.add_argument("--output", required=True)
+    p.add_argument("--n", type=int, default=1000)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+
     p = sub.add_parser("imagenet")
     p.add_argument("--folder", required=True,
                    help="dataset root holding img/ and rid.txt")
@@ -397,6 +452,17 @@ def main(argv: list[str] | None = None) -> int:
                 args.n, args.classes, args.size, args.seed,
                 channels=args.channels,
             ),
+        )
+    elif args.source == "text":
+        n = write_records(
+            args.output, *text_token_arrays(args.input, args.seq_len,
+                                            args.stride)
+        )
+    elif args.source == "tokens":
+        n = write_records(
+            args.output,
+            *synthetic_token_arrays(args.n, args.seq_len, args.vocab,
+                                    args.seed),
         )
     elif args.source == "imagenet":
         n = write_imagenet(args.folder, args.output, args.size)

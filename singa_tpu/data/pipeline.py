@@ -83,21 +83,24 @@ def load_lmdb_arrays(path: str) -> tuple[np.ndarray, np.ndarray]:
         for key, val in reader:
             rec = datum_to_image_record(decode_datum(val))
             shape = tuple(rec.shape) if any(rec.shape) else (-1,)
-            if first_shape is None:
-                first_shape = shape
-            elif shape != first_shape:
-                raise ValueError(
-                    f"LMDB {path!r}: record {key!r} has shape {shape}, "
-                    f"others {first_shape} — mixed geometry cannot be "
-                    "batched; re-export at a uniform size"
-                )
             if rec.pixel:
                 img = np.frombuffer(rec.pixel, dtype=np.uint8).astype(
                     np.float32
                 )
             else:
                 img = np.asarray(rec.data, dtype=np.float32)
-            images.append(img.reshape(shape))
+            img = img.reshape(shape)
+            # compare post-reshape shapes so shapeless records (which all
+            # normalize to (-1,)) still trip on differing lengths
+            if first_shape is None:
+                first_shape = img.shape
+            elif img.shape != first_shape:
+                raise ValueError(
+                    f"LMDB {path!r}: record {key!r} has shape {img.shape}, "
+                    f"others {first_shape} — mixed geometry cannot be "
+                    "batched; re-export at a uniform size"
+                )
+            images.append(img)
             labels.append(rec.label)
     if not images:
         raise ValueError(f"LMDB {path!r} holds no records")

@@ -88,6 +88,7 @@ class Net:
         for layer in self.layers:
             src_shapes = [shapes[s] for s in layer.srclayers]
             out = layer.setup(src_shapes, batchsize)
+            layer.validate([self.name2layer[s] for s in layer.srclayers])
             if layer.is_datalayer:
                 batchsize = layer.batchsize
             if isinstance(layer, SliceLayer):

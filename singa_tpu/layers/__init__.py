@@ -27,6 +27,14 @@ from .data import (
 from .loss import EuclideanLossLayer, SoftmaxLossLayer
 from .norm import AddLayer, BatchNormLayer, GlobalPoolingLayer
 from .rbm import RBMLayer
+from .sequence import (
+    AttentionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    LayerNormLayer,
+    LMLossLayer,
+    SequenceDataLayer,
+)
 from .neuron import (
     ConvolutionLayer,
     DropoutLayer,
@@ -64,13 +72,21 @@ def registered_types() -> list[str]:
 
 # the reference's 18 built-ins (neuralnet.cc:13-33) + extensions:
 # kSigmoid, kRBM + kEuclideanLoss (the CD/autoencoder path, BASELINE #4),
-# kBatchNorm/kAdd/kGlobalPooling (the ResNet vocabulary, BASELINE #5)
+# kBatchNorm/kAdd/kGlobalPooling (the ResNet vocabulary, BASELINE #5),
+# kSequenceData/kEmbedding/kLayerNorm/kAttention/kDense/kLMLoss (the
+# transformer-LM vocabulary — long-context as a config citizen)
 for _cls in (
     RBMLayer,
     EuclideanLossLayer,
     AddLayer,
     BatchNormLayer,
     GlobalPoolingLayer,
+    SequenceDataLayer,
+    EmbeddingLayer,
+    LayerNormLayer,
+    AttentionLayer,
+    DenseLayer,
+    LMLossLayer,
     ConvolutionLayer,
     ConcateLayer,
     DropoutLayer,

@@ -66,6 +66,11 @@ class Layer:
         """Infer the output shape and declare params; returns out_shape."""
         raise NotImplementedError
 
+    def validate(self, src_layers: Sequence["Layer"]) -> None:
+        """Optional cross-layer check, called by Net.setup with the
+        actual source layer objects after this layer's setup (shape
+        inference alone can't see e.g. a data layer's value range)."""
+
     def param_specs(self) -> dict[str, ParamSpec]:
         """Qualified-name -> spec, declared during setup."""
         return self._param_specs

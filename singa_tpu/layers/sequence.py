@@ -1,0 +1,245 @@
+"""Sequence-modeling layers (singa-tpu extensions).
+
+The reference predates sequence models entirely (SURVEY §5: no attention
+op anywhere); these layers make byte/token language models expressible in
+the same text-proto job surface as every other net, training through the
+identical engine — device cache, scan chunks, bf16 compute, checkpoints.
+The code-level transformer API (singa_tpu/models/transformer.py, with
+ring attention for sequence parallelism) remains the power-user path;
+this is the config-driven one.
+
+Data flows as (B, S) int32 tokens from kSequenceData, through kEmbedding
+-> (B, S, D), residual blocks built from kLayerNorm / kAttention /
+kDense / kAdd, into kLMLoss (next-token cross-entropy).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ConfigError
+from ..ops.attention import attention, flash_attention
+from .base import Layer, Shape, require_one_src
+from .data import _ArrayDataLayer
+
+
+def load_token_arrays(path: str):
+    """Decode a token shard: each record's uint8 ``pixel`` bytes are one
+    fixed-length sequence (byte-level vocab), label unused. -> (tokens
+    int32 (N, S), labels int32 (N,))."""
+    from ..data.pipeline import load_shard_arrays
+
+    images, labels = load_shard_arrays(path)
+    if images.ndim != 2:
+        raise ConfigError(
+            f"token shard {path!r}: expected flat (N, S) sequences, got "
+            f"shape {images.shape}"
+        )
+    return images.astype("int32"), labels
+
+
+class SequenceDataLayer(_ArrayDataLayer):
+    """kSequenceData: batches of fixed-length token sequences."""
+
+    TYPE = "kSequenceData"
+    LOADER = staticmethod(load_token_arrays)
+
+
+class EmbeddingLayer(Layer):
+    """kEmbedding: token + learned positional embedding."""
+
+    TYPE = "kEmbedding"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.embedding_param
+        if p is None:
+            raise ConfigError(
+                f"layer {self.name!r}: embedding_param required"
+            )
+        src = require_one_src(self, src_shapes)
+        if len(src) != 2:
+            raise ConfigError(
+                f"layer {self.name!r}: expects (batch, seq) token input"
+            )
+        self.seq_len = src[1]
+        self.vocab = p.vocab_size
+        self.dim = p.embedding_dim
+        max_len = p.max_len or self.seq_len
+        if max_len < self.seq_len:
+            raise ConfigError(
+                f"layer {self.name!r}: max_len {max_len} < sequence "
+                f"length {self.seq_len}"
+            )
+        self.tok = self._declare_param(
+            0, "tok", (self.vocab, self.dim), fan_in=self.dim
+        )
+        self.pos = self._declare_param(
+            1, "pos", (max_len, self.dim), fan_in=self.dim
+        )
+        return (src[0], self.seq_len, self.dim)
+
+    def validate(self, src_layers) -> None:
+        # JAX gather clamps out-of-range ids silently, so an undersized
+        # vocab would train on garbage without this build-time check
+        src = src_layers[0]
+        if getattr(src, "is_datalayer", False) and hasattr(src, "images"):
+            top = int(src.images.max())
+            if top >= self.vocab:
+                raise ConfigError(
+                    f"layer {self.name!r}: vocab_size {self.vocab} <= max "
+                    f"token id {top} in {src.name!r}'s data"
+                )
+
+    def apply(self, params, inputs, *, training, rng=None):
+        tokens = inputs[0]["image"].astype(jnp.int32)
+        s = tokens.shape[1]
+        return params[self.tok][tokens] + params[self.pos][:s]
+
+
+class LayerNormLayer(Layer):
+    """kLayerNorm over the last dim; stats in fp32 under bf16 compute."""
+
+    TYPE = "kLayerNorm"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.layernorm_param
+        self.eps = p.eps if p else 1e-5
+        src = require_one_src(self, src_shapes)
+        d = src[-1]
+        self.scale = self._declare_param(0, "scale", (d,))
+        self.bias = self._declare_param(1, "bias", (d,))
+        return src
+
+    def apply(self, params, inputs, *, training, rng=None):
+        x = inputs[0]
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
+        return (
+            y.astype(x.dtype) * params[self.scale] + params[self.bias]
+        ).astype(x.dtype)
+
+
+class AttentionLayer(Layer):
+    """kAttention: causal multi-head self-attention with fused QKV."""
+
+    TYPE = "kAttention"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.attention_param
+        if p is None:
+            raise ConfigError(
+                f"layer {self.name!r}: attention_param required"
+            )
+        src = require_one_src(self, src_shapes)
+        if len(src) != 3:
+            raise ConfigError(
+                f"layer {self.name!r}: expects (batch, seq, dim) input"
+            )
+        d = src[-1]
+        self.heads = p.num_heads
+        if d % self.heads:
+            raise ConfigError(
+                f"layer {self.name!r}: dim {d} not divisible by "
+                f"num_heads {self.heads}"
+            )
+        self.mode = p.mode
+        self.qkv = self._declare_param(
+            0, "qkv", (d, 3 * d), fan_in=d, neuron_axis=1
+        )
+        self.out = self._declare_param(
+            1, "out", (d, d), fan_in=d, neuron_axis=0
+        )
+        return src
+
+    def apply(self, params, inputs, *, training, rng=None):
+        x = inputs[0]
+        b, s, d = x.shape
+        w = params[self.qkv]
+        qkv = (x.astype(w.dtype) @ w).reshape(
+            b, s, 3, self.heads, d // self.heads
+        )
+        q, k, v = (jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3))
+        if self.mode == "flash":
+            o = flash_attention(q, k, v, True)
+        else:
+            o = attention(q, k, v, causal=True)
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, d)
+        return o.astype(w.dtype) @ params[self.out]
+
+
+class DenseLayer(Layer):
+    """kDense: per-position linear map over the last dim (+ optional
+    fused activation). Contrast kInnerProduct, which flattens."""
+
+    TYPE = "kDense"
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.dense_param
+        if p is None:
+            raise ConfigError(f"layer {self.name!r}: dense_param required")
+        src = require_one_src(self, src_shapes)
+        d = src[-1]
+        self.hdim = p.num_output
+        self.activation = p.activation
+        self.w = self._declare_param(
+            0, "weight", (d, self.hdim), fan_in=d, neuron_axis=1
+        )
+        self.bias_term = p.bias_term
+        if self.bias_term:
+            self.b = self._declare_param(1, "bias", (self.hdim,))
+        return (*src[:-1], self.hdim)
+
+    def apply(self, params, inputs, *, training, rng=None):
+        w = params[self.w]
+        out = inputs[0].astype(w.dtype) @ w
+        if self.bias_term:
+            out = out + params[self.b]
+        if self.activation == "gelu":
+            out = jax.nn.gelu(out)
+        elif self.activation == "relu":
+            out = jax.nn.relu(out)
+        return out
+
+
+class LMLossLayer(Layer):
+    """kLMLoss: next-token cross-entropy over (B, S, V) logits.
+
+    srclayers: (logits, kSequenceData). Position t's logits predict token
+    t+1; the final position is dropped. Metrics: loss (mean NLL) and
+    precision (next-token top-1 accuracy), averaged by Performance like
+    every loss layer."""
+
+    TYPE = "kLMLoss"
+    is_losslayer = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        if len(src_shapes) != 2:
+            raise ConfigError(
+                f"layer {self.name!r}: kLMLoss needs (logits, tokens) "
+                f"srclayers, got {len(src_shapes)}"
+            )
+        if len(src_shapes[0]) != 3:
+            raise ConfigError(
+                f"layer {self.name!r}: logits must be (batch, seq, vocab)"
+            )
+        return src_shapes[0]
+
+    def apply(self, params, inputs, *, training, rng=None):
+        logits, feed = inputs
+        tokens = feed["image"].astype(jnp.int32)
+        logp = jax.nn.log_softmax(
+            logits[:, :-1].astype(jnp.float32), axis=-1
+        )
+        targets = tokens[:, 1:]
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(ll)
+        hit = jnp.argmax(logp, axis=-1) == targets
+        return loss, {
+            "loss": loss,
+            "precision": jnp.mean(hit.astype(jnp.float32)),
+        }
