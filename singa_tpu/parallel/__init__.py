@@ -19,6 +19,14 @@ from .consistency import (
 )
 from .launch import coordinator_address, init_distributed, read_hostfile
 from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, mesh_from_cluster
+from .moe import (
+    build_ep_mesh,
+    init_moe,
+    moe_ffn,
+    moe_ffn_dense,
+    moe_param_shardings,
+)
+from .pipeline import build_pp_mesh, pipeline_apply, stage_param_shardings
 from .shardings import (
     batch_shardings,
     param_shardings,
@@ -34,6 +42,14 @@ __all__ = [
     "coordinator_address",
     "init_distributed",
     "read_hostfile",
+    "build_ep_mesh",
+    "init_moe",
+    "moe_ffn",
+    "moe_ffn_dense",
+    "moe_param_shardings",
+    "build_pp_mesh",
+    "pipeline_apply",
+    "stage_param_shardings",
     "batch_shardings",
     "param_shardings",
     "replicated",

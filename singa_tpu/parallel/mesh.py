@@ -48,6 +48,23 @@ def build_mesh(
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+def axis_pair_mesh(
+    ndata: int, n: int, axis: str, devices=None, kind: str = "mesh"
+) -> Mesh:
+    """A ('data', axis) mesh over the first ndata*n devices — the shared
+    builder behind the sp/ep/pp meshes (the second axis innermost so its
+    collectives ride neighboring ICI hops, like MODEL_AXIS here)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    need = ndata * n
+    if need > len(devices):
+        raise ValueError(
+            f"{kind} wants {ndata}x{n}={need} devices, "
+            f"only {len(devices)} visible"
+        )
+    grid = np.array(devices[:need]).reshape(ndata, n)
+    return Mesh(grid, ("data", axis))
+
+
 def mesh_from_cluster(
     cluster: ClusterConfig | None, devices=None
 ) -> Mesh:

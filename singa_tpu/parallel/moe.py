@@ -1,0 +1,154 @@
+"""Mixture-of-experts FFN with expert parallelism.
+
+The reference has no MoE (pre-transformer); this extension completes the
+framework's parallelism vocabulary (dp/tp/sp/ep). The design is
+GShard/Switch-style top-1 routing with a capacity limit, executed the
+TPU way: routing builds a dense dispatch tensor (no ragged scatter — the
+MXU sees einsums), experts' weights shard over a mesh axis, and the
+combine is one psum over that axis. Under shard_map each device:
+
+  1. computes gating for its (possibly data-sharded) tokens,
+  2. dispatches tokens into its LOCAL experts' (capacity, d) buffers,
+  3. runs the local experts' FFN,
+  4. un-dispatches and psums partial outputs across the expert axis.
+
+Dropped tokens (over capacity) pass through on the residual path, like
+Switch Transformer. Routing/combine math stays fp32 under bf16 compute.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import axis_pair_mesh
+
+EXPERT_AXIS = "expert"
+
+
+def build_ep_mesh(ndata: int = 1, nexpert: int = 1, devices=None) -> Mesh:
+    """A (data, expert) mesh: batch shards over data, experts over expert."""
+    return axis_pair_mesh(ndata, nexpert, EXPERT_AXIS, devices, "ep mesh")
+
+
+def init_moe(
+    rng: jax.Array, d_model: int, d_ff: int, n_experts: int
+) -> dict:
+    """Param pytree: gate (D, E), experts' up (E, D, F) / down (E, F, D)."""
+    kg, ku, kd = jax.random.split(rng, 3)
+    s = 1.0 / np.sqrt(d_model)
+    return {
+        "gate": s * jax.random.normal(kg, (d_model, n_experts)),
+        "up": s * jax.random.normal(ku, (n_experts, d_model, d_ff)),
+        "down": (1.0 / np.sqrt(d_ff))
+        * jax.random.normal(kd, (n_experts, d_ff, d_model)),
+    }
+
+
+def _route(x2d: jnp.ndarray, gate_w: jnp.ndarray, capacity: int):
+    """Top-1 routing -> (dispatch (N, E, C) one-hot, combine weights,
+    aux load-balancing loss). All fp32."""
+    logits = x2d.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+    expert = jnp.argmax(probs, axis=-1)  # (N,)
+    onehot = jax.nn.one_hot(expert, gate_w.shape[1], dtype=jnp.float32)
+    # each token's position in its expert's queue (0-based)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot, axis=-1)
+    kept = pos < capacity  # over-capacity tokens drop to the residual
+    slot = jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32
+    ) * kept[:, None]
+    dispatch = onehot[:, :, None] * slot[:, None, :]  # (N, E, C)
+    gate_val = jnp.sum(probs * onehot, axis=-1)  # (N,)
+    combine = dispatch * gate_val[:, None, None]
+    # Switch load-balancing aux: mean fraction-routed x mean prob per expert
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = gate_w.shape[1] * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn_dense(x: jnp.ndarray, params: dict, capacity_factor: float = 1.25):
+    """Single-device reference MoE: x (B, S, D) -> (y, aux_loss)."""
+    b, s, d = x.shape
+    n = b * s
+    e = params["gate"].shape[1]
+    capacity = max(1, int(capacity_factor * n / e))
+    x2d = x.reshape(n, d)
+    dispatch, combine, aux = _route(x2d, params["gate"], capacity)
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", dispatch, x2d.astype(jnp.float32)
+    )
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["up"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["down"])
+    y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return y.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    params: dict,
+    mesh: Mesh,
+    *,
+    capacity_factor: float = 1.25,
+    axis: str = EXPERT_AXIS,
+):
+    """Expert-parallel MoE over ``mesh``'s expert axis.
+
+    x (B, S, D) with batch optionally sharded over "data"; expert weights
+    (E, ...) sharded over ``axis``. Each shard routes its local tokens,
+    computes only its local experts, and the combine psums partial
+    outputs across the expert axis. Numerically identical to
+    moe_ffn_dense (same routing decisions; capacity is per data shard).
+    """
+    nexp = mesh.shape[axis]
+    if nexp == 1:
+        return moe_ffn_dense(x, params, capacity_factor)
+    data = "data" if "data" in mesh.shape else None
+
+    def local(x, gate_w, up, down):
+        b, s, d = x.shape
+        n = b * s
+        e_total = gate_w.shape[1]
+        capacity = max(1, int(capacity_factor * n / e_total))
+        x2d = x.reshape(n, d)
+        dispatch, combine, aux = _route(x2d, gate_w, capacity)
+        # this shard owns experts [my*e_local, (my+1)*e_local)
+        e_local = up.shape[0]
+        my = jax.lax.axis_index(axis)
+        lo = my * e_local
+        dsp = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, axis=1)
+        cmb = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, axis=1)
+        expert_in = jnp.einsum("nec,nd->ecd", dsp, x2d.astype(jnp.float32))
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, up))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, down)
+        y = jnp.einsum("nec,ecd->nd", cmb, expert_out)
+        y = jax.lax.psum(y, axis)  # combine partial expert outputs
+        # aux is identical on every expert shard (gating is replicated);
+        # shape (1,) so the data axis can stack shards' values
+        return y.reshape(b, s, d).astype(x.dtype), aux.reshape(1)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(data, None, None),      # x: batch over data, replicated on ep
+            P(),                       # gate replicated
+            P(axis, None, None),       # up sharded over experts
+            P(axis, None, None),       # down sharded over experts
+        ),
+        out_specs=(P(data, None, None), P(data)),
+    )
+    y, aux = fn(x, params["gate"], params["up"], params["down"])
+    return y, jnp.mean(aux)
+
+
+def moe_param_shardings(mesh: Mesh, axis: str = EXPERT_AXIS) -> dict:
+    """Placement for init_moe params on an ep mesh."""
+    return {
+        "gate": NamedSharding(mesh, P()),
+        "up": NamedSharding(mesh, P(axis, None, None)),
+        "down": NamedSharding(mesh, P(axis, None, None)),
+    }

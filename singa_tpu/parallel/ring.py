@@ -40,15 +40,9 @@ def build_sp_mesh(ndata: int = 1, nseq: int = 1, devices=None) -> Mesh:
 
     The seq axis is innermost so the K/V ring rides neighboring devices
     (fastest ICI hops), like the model axis in build_mesh."""
-    devices = list(jax.devices()) if devices is None else list(devices)
-    need = ndata * nseq
-    if need > len(devices):
-        raise ValueError(
-            f"sp mesh wants {ndata}x{nseq}={need} devices, "
-            f"only {len(devices)} visible"
-        )
-    grid = np.array(devices[:need]).reshape(ndata, nseq)
-    return Mesh(grid, ("data", SEQ_AXIS))
+    from .mesh import axis_pair_mesh
+
+    return axis_pair_mesh(ndata, nseq, SEQ_AXIS, devices, "sp mesh")
 
 
 def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool):
