@@ -41,6 +41,11 @@ class TransformerConfig:
     d_ff: int = 1024
     max_len: int = 1024
     attn: str = "dense"  # dense | flash | ring
+    #: >0 replaces every block's FFN with a Switch MoE of this many
+    #: experts (parallel/moe.py); pair with an "expert" mesh axis for
+    #: expert parallelism. The load-balancing aux joins lm_loss.
+    moe_experts: int = 0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -69,10 +74,19 @@ def init_lm(rng: jax.Array, cfg: TransformerConfig) -> dict:
         )
         params[f"{p}/ln2/scale"] = jnp.ones((d,))
         params[f"{p}/ln2/bias"] = jnp.zeros((d,))
-        params[f"{p}/mlp/up"] = norm(next(keys), (d, f), 1 / math.sqrt(d))
-        params[f"{p}/mlp/down"] = norm(
-            next(keys), (f, d), 1 / math.sqrt(f * 2 * cfg.n_layers)
-        )
+        if cfg.moe_experts:
+            from ..parallel.moe import init_moe
+
+            moe = init_moe(next(keys), d, f, cfg.moe_experts)
+            for k, v in moe.items():
+                params[f"{p}/moe/{k}"] = v
+        else:
+            params[f"{p}/mlp/up"] = norm(
+                next(keys), (d, f), 1 / math.sqrt(d)
+            )
+            params[f"{p}/mlp/down"] = norm(
+                next(keys), (f, d), 1 / math.sqrt(f * 2 * cfg.n_layers)
+            )
     params["ln_f/scale"] = jnp.ones((cfg.d_model,))
     params["ln_f/bias"] = jnp.zeros((cfg.d_model,))
     return params
@@ -99,10 +113,16 @@ def lm_apply(
     tokens: jnp.ndarray,
     cfg: TransformerConfig,
     mesh=None,
-) -> jnp.ndarray:
-    """tokens (B, S) int32 -> logits (B, S, vocab); causal."""
+    *,
+    return_aux: bool = False,
+):
+    """tokens (B, S) int32 -> logits (B, S, vocab); causal.
+
+    With ``return_aux`` also returns the summed MoE load-balancing loss
+    (0.0 for dense-FFN configs)."""
     b, s = tokens.shape
     x = params["embed/tok"][tokens] + params["embed/pos"][:s]
+    aux_total = jnp.float32(0.0)
     for i in range(cfg.n_layers):
         p = f"blk{i}"
         h = _layernorm(x, params[f"{p}/ln1/scale"], params[f"{p}/ln1/bias"])
@@ -116,10 +136,26 @@ def lm_apply(
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, cfg.d_model)
         x = x + o @ params[f"{p}/attn/out"]
         h = _layernorm(x, params[f"{p}/ln2/scale"], params[f"{p}/ln2/bias"])
-        h = jax.nn.gelu(h @ params[f"{p}/mlp/up"])
-        x = x + h @ params[f"{p}/mlp/down"]
+        if cfg.moe_experts:
+            from ..parallel.moe import moe_ffn, moe_ffn_dense
+
+            moe_params = {
+                k: params[f"{p}/moe/{k}"] for k in ("gate", "up", "down")
+            }
+            if mesh is not None and "expert" in getattr(mesh, "shape", {}):
+                y, aux = moe_ffn(h, moe_params, mesh)
+            else:
+                y, aux = moe_ffn_dense(h, moe_params)
+            x = x + y
+            aux_total = aux_total + aux
+        else:
+            h = jax.nn.gelu(h @ params[f"{p}/mlp/up"])
+            x = x + h @ params[f"{p}/mlp/down"]
     x = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
-    return x @ params["embed/tok"].T
+    logits = x @ params["embed/tok"].T
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def lm_loss(
@@ -132,9 +168,10 @@ def lm_loss(
 
     The forward runs on the full (ring-divisible) sequence; the loss
     drops the last position's prediction instead of trimming the input,
-    so ring sharding never sees an odd S-1 length."""
-    logits = lm_apply(params, tokens, cfg, mesh)
+    so ring sharding never sees an odd S-1 length. MoE configs add the
+    weighted load-balancing aux."""
+    logits, aux = lm_apply(params, tokens, cfg, mesh, return_aux=True)
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     targets = tokens[:, 1:]
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.moe_aux_weight * aux

@@ -100,8 +100,11 @@ def moe_ffn(
     x (B, S, D) with batch optionally sharded over "data"; expert weights
     (E, ...) sharded over ``axis``. Each shard routes its local tokens,
     computes only its local experts, and the combine psums partial
-    outputs across the expert axis. Numerically identical to
-    moe_ffn_dense (same routing decisions; capacity is per data shard).
+    outputs across the expert axis. With an unsharded batch (ndata == 1)
+    this is numerically identical to moe_ffn_dense; under data sharding,
+    capacity and queue order are per data shard, so over-capacity DROP
+    decisions can differ from the global dense reference (outputs for
+    kept tokens are identical either way).
     """
     nexp = mesh.shape[axis]
     if nexp == 1:
