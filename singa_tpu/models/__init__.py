@@ -4,10 +4,15 @@ The reference declares (but leaves empty) programmatic net construction —
 NeuralNet::AddLayer, include/worker/neuralnet.h:61-65 — alongside its
 proto-driven builder. This package is that surface made real: models
 built directly against the op vocabulary, for families beyond the
-config schema's layer types (currently the transformer LM that makes
-long-context/sequence-parallel training first-class).
+config schema's layer types:
+
+  transformer  decoder-only LM (dense/flash/ring attention, optional
+               Switch-MoE FFN with expert parallelism)
+  resnet       ResNet-18/34/50/101/152 *job-config generator* — emits
+               text-proto files for the standard engine
 """
 
+from .resnet import resnet_conf
 from .transformer import (
     TransformerConfig,
     init_lm,
@@ -15,4 +20,10 @@ from .transformer import (
     lm_loss,
 )
 
-__all__ = ["TransformerConfig", "init_lm", "lm_apply", "lm_loss"]
+__all__ = [
+    "TransformerConfig",
+    "init_lm",
+    "lm_apply",
+    "lm_loss",
+    "resnet_conf",
+]
