@@ -166,7 +166,18 @@ class LMDBReader:
             raise LMDBError(f"{self.path!r}: page {pgno} beyond EOF")
         return self._pread(pgno * self.psize, self.psize)
 
-    def _iter_page(self, pgno: int) -> Iterator[tuple[bytes, bytes]]:
+    def _iter_page(
+        self, pgno: int, depth: int = 0
+    ) -> Iterator[tuple[bytes, bytes]]:
+        # guard corrupt/crafted B+trees the same way the native walker
+        # does (native/lmdbcodec.cc): a depth cap plus a visit budget of
+        # one traversal per page in the file, so a branch-page cycle
+        # raises LMDBError instead of RecursionError
+        if depth > 64:
+            raise LMDBError(f"{self.path!r}: corrupt B+tree (depth > 64)")
+        self._visits += 1
+        if self._visits > max(1, self._size // self.psize):
+            raise LMDBError(f"{self.path!r}: corrupt B+tree (page cycle)")
         page = self._page(pgno)
         _, _, flags, lower, _ = _PAGEHDR.unpack_from(page, 0)
         if flags & P_LEAF2:
@@ -179,7 +190,7 @@ class LMDBReader:
             for off in ptrs:
                 lo, hi, nflags, _ = _NODEHDR.unpack_from(page, off)
                 child = lo | (hi << 16) | (nflags << 32)
-                yield from self._iter_page(child)
+                yield from self._iter_page(child, depth + 1)
         elif flags & P_LEAF:
             for off in ptrs:
                 lo, hi, nflags, ksize = _NODEHDR.unpack_from(page, off)
@@ -213,6 +224,7 @@ class LMDBReader:
     def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
         if self.meta.root == P_INVALID:
             return
+        self._visits = 0
         yield from self._iter_page(self.meta.root)
 
     def close(self) -> None:
@@ -321,8 +333,7 @@ def write_lmdb(
             if key == prev_key:
                 raise LMDBError(f"duplicate key {key!r}")
             raise LMDBError(
-                f"keys out of order ({key!r} after {prev_key!r}) with "
-                "assume_sorted=True"
+                f"keys out of order ({key!r} after {prev_key!r})"
             )
         prev_key = key
         n_items += 1

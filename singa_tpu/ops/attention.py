@@ -156,21 +156,22 @@ def _flash_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     *, causal, block_k, seq_k, scale,
 ):
     """dQ for one (batch*head, q-block): stream K/V blocks.
 
     FlashAttention backward recurrences: P = exp(S - lse),
     dS = P * (dO V^T - D) with D = rowsum(dO * O), dQ = dS K * scale.
+    D arrives precomputed per row (like lse) so neither backward kernel
+    redoes the rowsum.
     """
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]  # D, (Bq,)
     bq, d = q.shape
-    delta = jnp.sum(do * o, axis=-1)  # D, (Bq,)
     q_offset = qi * bq
     dq = jnp.zeros((bq, d), dtype=jnp.float32)
 
@@ -197,7 +198,7 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     *, causal, block_q, seq_q, scale,
 ):
     """dK/dV for one (batch*head, k-block): stream Q/dO/O blocks.
@@ -216,8 +217,8 @@ def _flash_bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
         s = (q @ k.T) * scale
         if causal:
             qpos = j * block_q + jnp.arange(block_q)
@@ -225,7 +226,6 @@ def _flash_bwd_dkv_kernel(
             mask = qpos[:, None] >= kpos[None, :]
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])  # (Bq, Bk)
-        delta = jnp.sum(do * o, axis=-1)
         ds = p * (do @ v.T - delta[:, None])
         return dk + (ds.T @ q) * scale, dv + p.T @ do
 
@@ -328,7 +328,13 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     bh = b * h
     scale = 1.0 / math.sqrt(d)
     flat = lambda x: x.reshape(bh, s, d)  # noqa: E731
-    args = (flat(q), flat(k), flat(v), flat(g), flat(out), lse)
+    # D = rowsum(dO * O), computed ONCE per row and fed to both kernels
+    # laid out (BH, 1, S) like lse
+    delta = jnp.sum(
+        flat(g).astype(jnp.float32) * flat(out).astype(jnp.float32),
+        axis=-1,
+    )[:, None, :]
+    args = (flat(q), flat(k), flat(v), flat(g), lse, delta)
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
     full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
@@ -340,7 +346,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             causal=causal, block_k=block_k, seq_k=s, scale=scale,
         ),
         grid=(bh, s // block_q),
-        in_specs=[qspec, full, full, qspec, qspec, lse_blk],
+        in_specs=[qspec, full, full, qspec, lse_blk, lse_blk],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=bool(interpret),
@@ -351,7 +357,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             causal=causal, block_q=block_q, seq_q=s, scale=scale,
         ),
         grid=(bh, s // block_k),
-        in_specs=[full, kspec, kspec, full, full, lse_full],
+        in_specs=[full, kspec, kspec, full, lse_full, lse_full],
         out_specs=[kspec, kspec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k.dtype),
