@@ -1,20 +1,46 @@
-"""Benchmark: MNIST MLP training throughput on the real chip.
+"""Benchmark: the framework's headline workloads on the real chip.
 
-Workload = the reference's headline job (examples/mnist/mlp.conf: six FC
-layers 2500-2000-1500-1000-500-10, batch 1000, SGD) — the same model the
-reference's batch.sh scaling sweep measures (examples/mnist/batch.sh:3-17)
-— on the production hot path: the device-cached, bf16-compute,
-lax.scan-chunked training engine (fp32 master params; convergence parity
-tests in tests/test_chunk.py and tests/test_trainer.py).
+Workloads (BASELINE.md targets; all on the production hot path — the
+device-cached, bf16-compute, lax.scan-chunked training engine):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured against BASELINE_SPS below — the round-2 real-TPU
-measurement recorded in BASELINE.md (the reference repo publishes no
-numbers, BASELINE.md:3-8, so our first TPU run is the baseline).
+  mnist_mlp     the reference's headline job (examples/mnist/mlp.conf:
+                six FC layers 2500-2000-1500-1000-500-10, batch 1000) —
+                the model its batch.sh scaling sweep measures
+                (examples/mnist/batch.sh:3-17)
+  cifar_alexnet examples/cifar10/alexnet.conf (BASELINE config 3), the
+                conv path
+  tinylm        examples/lm/tinylm.conf, byte-level transformer LM with
+                the Pallas flash-attention kernel (tokens/sec)
+  resnet50      examples/imagenet/resnet50.conf train step (BASELINE
+                stretch config 5), 224x224, BatchNorm buffers threaded
 
-Timing forces a value materialization instead of block_until_ready: the
-tunneled device lets block_until_ready return early (BASELINE.md r2 note),
-which inflated earlier rounds' numbers.
+Each workload reports {samples_per_sec, step_ms, model_flops, mfu,
+phase_ms}: model_flops is the analytic per-step matmul count
+(singa_tpu/utils/flops.py, 3x forward; causal attention at half
+density), mfu divides achieved FLOP/s by the chip's bf16 peak
+(device_kind table; override SINGA_TPU_PEAK_TFLOPS), and phase_ms are
+the per-phase host timers — TimerInfo parity with the reference
+(include/worker/worker.h:91-114).
+
+Prints ONE JSON line. The top-level {metric, value, unit, vs_baseline}
+keeps the driver contract and carries the headline MNIST MLP number;
+"workloads" holds the full array.
+
+Timing methodology (round 3): a dispatch + value-materialization round
+trip through the tunneled device costs ~115 ms REGARDLESS of the
+program (measured: sync of a ready scalar after one dispatch), so any
+fixed-window measurement is latency-inflated. Each workload therefore
+times TWO window sizes and reports the SLOPE
+(T(n2) - T(n1)) / (n2 - n1) — the marginal per-step cost, which is what
+a directly-attached TPU would see. The fixed intercept is reported as
+fixed_overhead_ms for transparency. Sync forces a value materialization
+instead of block_until_ready (the tunnel lets block_until_ready return
+early, BASELINE.md r2 note).
+
+vs_baseline: BASELINE_SPS is the round-2 bf16 chunked-engine MNIST MLP
+measurement from BASELINE.md. It used a single 100-step window, so its
+~115 ms latency share inflated per-step cost ~3.5x; baseline_note says
+so. The reference repo publishes no numbers (BASELINE.md:3-8).
 """
 
 from __future__ import annotations
@@ -22,64 +48,245 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import time
+import traceback
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-# First real-chip measurement (round 2, TPU v5 lite, fp32 path, prefetch
-# pipeline): 55096 samples/sec. Later measurements compare against this.
-BASELINE_SPS = 55_096.0
+# Round-2 bf16 chunked-engine measurement on the MNIST MLP (BASELINE.md
+# "Measured" table) — single-window methodology, latency-inflated.
+BASELINE_SPS = 864_498.0
+BASELINE_NOTE = (
+    "r2 bf16 chunked-engine MNIST MLP measurement (BASELINE.md); r2 used "
+    "a single 100-step window whose ~115ms tunnel round-trip inflated "
+    "per-step cost — r3+ reports the two-window slope instead. The "
+    "reference publishes no numbers"
+)
 
-MEASURE_STEPS = 100
-TRIALS = 3
 
+def _bench_trainer(trainer, n1: int, n2: int, trials: int = 2):
+    """Slope-fit the per-step cost: time n1-step and n2-step windows
+    (best of `trials` each) and return (slope_sec_per_step,
+    fixed_overhead_sec, total_timed_steps).
 
-def main() -> int:
+    Uses the chunked engine when available (one dispatch per chunk cap),
+    otherwise the per-step loop. Sync = value materialization — the
+    only sync the tunnel can't elide.
+    """
     import jax.numpy as jnp
 
-    from __graft_entry__ import _flagship_cfg
-    from singa_tpu.trainer import Trainer
-
-    cfg = _flagship_cfg(batchsize=1000)
-    cfg.train_steps = MEASURE_STEPS * (TRIALS + 1)
-    cfg.test_steps = 0
-    cfg.display_frequency = 0
-    cfg.compute_dtype = "bfloat16"
-    trainer = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
-
     def sync() -> float:
-        # value materialization: the only sync the tunnel can't elide
         return float(jnp.sum(jnp.abs(next(iter(trainer.params.values())))))
 
     if trainer._can_chunk():
-        run = trainer.train_chunk
-    else:  # fallback: per-step loop (kept for non-cacheable datasets)
-        def run(step0, nsteps):
-            for s in range(step0, step0 + nsteps):
+        cap = trainer._chunk_cap()
+
+        def run(step0, n):
+            s = step0
+            while s < step0 + n:
+                take = min(cap, step0 + n - s)
+                trainer.train_chunk(s, take)
+                s += take
+    else:
+        def run(step0, n):
+            for s in range(step0, step0 + n):
                 trainer.train_one_batch(s)
 
-    run(0, MEASURE_STEPS)  # warmup compiles this chunk length
+    # warm: compile every chunk length both windows will use
+    run(0, n1)
+    run(n1, n2)
     sync()
-    dt = float("inf")
-    for trial in range(TRIALS):
-        t0 = time.perf_counter()
-        run(MEASURE_STEPS * (trial + 1), MEASURE_STEPS)
-        sync()
-        dt = min(dt, time.perf_counter() - t0)
+    trainer.timers.reset()
+    step = n1 + n2
+    best = {}
+    for n in (n1, n2):
+        best[n] = float("inf")
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            run(step, n)
+            sync()
+            best[n] = min(best[n], time.perf_counter() - t0)
+            step += n
+    slope = (best[n2] - best[n1]) / (n2 - n1)
+    overhead = best[n1] - slope * n1
+    return slope, overhead, trials * (n1 + n2)
 
-    sps = MEASURE_STEPS * trainer.train_net.batchsize / dt
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_mlp_train_throughput",
-                "value": round(sps, 1),
-                "unit": "samples/sec",
-                "vs_baseline": round(sps / BASELINE_SPS, 3),
-            }
-        )
+
+def _workload_result(name, trainer, slope, overhead, timed_steps,
+                     unit="samples/sec", tokens_per_sample=None):
+    from singa_tpu.utils.flops import device_peak_flops, train_step_flops
+
+    batch = trainer.train_net.batchsize
+    sps = batch / slope
+    flops = train_step_flops(trainer.train_net)
+    peak = device_peak_flops()
+    mfu = (flops / slope) / peak if peak else None
+    value = sps * tokens_per_sample if tokens_per_sample else sps
+    # host-side phase timers over every timed step (dispatch cost under
+    # the chunked engine; full host loop otherwise)
+    t = trainer.timers
+    phase_ms = {
+        ph: round(t.total(ph) / timed_steps * 1e3, 4) for ph in t.phases()
+    }
+    return {
+        "name": name,
+        "value": round(value, 1),
+        "unit": unit,
+        "samples_per_sec": round(sps, 1),
+        "step_ms": round(slope * 1e3, 4),
+        "fixed_overhead_ms": round(overhead * 1e3, 1),
+        "batch": batch,
+        "model_flops": flops,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "phase_ms": phase_ms,
+        "method": "two-window slope fit (marginal per-step cost)",
+    }
+
+
+def _tmpdir() -> str:
+    return tempfile.mkdtemp(prefix="singa_tpu_bench_")
+
+
+def _prep_cfg(cfg, nsteps: int, bf16: bool = False):
+    """Silence cadences and size train_steps for a slope-fit run."""
+    cfg.train_steps = nsteps
+    cfg.test_steps = 0
+    cfg.display_frequency = 0
+    cfg.checkpoint_frequency = 0
+    if bf16:
+        cfg.compute_dtype = "bfloat16"
+    return cfg
+
+
+def _run_workload(name, cfg, n1, n2, unit="samples/sec",
+                  tokens_per_sample=None):
+    from singa_tpu.trainer import Trainer
+
+    trainer = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
+    slope, ovh, ts = _bench_trainer(trainer, n1, n2)
+    return _workload_result(
+        name, trainer, slope, ovh, ts,
+        unit=unit, tokens_per_sample=tokens_per_sample,
     )
+
+
+def bench_mnist_mlp(n1=256, n2=1280):
+    from __graft_entry__ import _flagship_cfg
+
+    cfg = _prep_cfg(_flagship_cfg(batchsize=1000), 4 * (n1 + n2), bf16=True)
+    return _run_workload("mnist_mlp", cfg, n1, n2)
+
+
+def bench_cifar_alexnet(n1=256, n2=1280, batch=256):
+    import numpy as np
+
+    from singa_tpu.config import load_model_config
+    from singa_tpu.data.loader import synthetic_arrays, write_records
+
+    cfg = load_model_config(
+        os.path.join(REPO, "examples", "cifar10", "alexnet.conf")
+    )
+    tmp = _tmpdir()
+    shard = os.path.join(tmp, "shard")
+    write_records(shard, *synthetic_arrays(512, size=32, channels=3, seed=0))
+    mean = os.path.join(tmp, "mean.npy")
+    np.save(mean, np.zeros((3, 32, 32), dtype=np.float32))
+    for layer in cfg.neuralnet.layer:
+        if layer.type == "kShardData":
+            layer.data_param.path = shard
+            layer.data_param.batchsize = batch
+            layer.data_param.random_skip = 0
+        if layer.rgbimage_param is not None and layer.rgbimage_param.meanfile:
+            layer.rgbimage_param.meanfile = mean
+    _prep_cfg(cfg, 4 * (n1 + n2), bf16=True)
+    return _run_workload("cifar_alexnet", cfg, n1, n2)
+
+
+def bench_tinylm(n1=256, n2=1280, seq_len=128):
+    from singa_tpu.config import load_model_config
+    from singa_tpu.data.loader import synthetic_token_arrays, write_records
+
+    cfg = load_model_config(os.path.join(REPO, "examples", "lm", "tinylm.conf"))
+    tmp = _tmpdir()
+    shard = os.path.join(tmp, "shard")
+    write_records(
+        shard, *synthetic_token_arrays(256, seq_len=seq_len, vocab=256)
+    )
+    for layer in cfg.neuralnet.layer:
+        if layer.type == "kSequenceData":
+            layer.data_param.path = shard
+    _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
+    return _run_workload(
+        "tinylm", cfg, n1, n2, unit="tokens/sec", tokens_per_sample=seq_len
+    )
+
+
+def bench_resnet50(n1=6, n2=18, batch=128):
+    from singa_tpu.config import load_model_config
+    from singa_tpu.data.loader import synthetic_arrays, write_records
+
+    cfg = load_model_config(
+        os.path.join(REPO, "examples", "imagenet", "resnet50.conf")
+    )
+    tmp = _tmpdir()
+    shard = os.path.join(tmp, "shard")
+    write_records(
+        shard, *synthetic_arrays(batch, size=256, channels=3, seed=0)
+    )
+    for layer in cfg.neuralnet.layer:
+        if layer.type == "kShardData":
+            layer.data_param.path = shard
+            layer.data_param.batchsize = batch
+            layer.data_param.random_skip = 0
+    _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
+    return _run_workload("resnet50", cfg, n1, n2)
+
+
+BENCHES = (
+    ("mnist_mlp", bench_mnist_mlp),
+    ("cifar_alexnet", bench_cifar_alexnet),
+    ("tinylm", bench_tinylm),
+    ("resnet50", bench_resnet50),
+)
+
+
+def main() -> int:
+    only = set(sys.argv[1:])
+    unknown = only - {name for name, _ in BENCHES}
+    if unknown:
+        print(f"unknown workload(s): {sorted(unknown)}; "
+              f"choose from {[n for n, _ in BENCHES]}", file=sys.stderr)
+        return 2
+    workloads = []
+    for name, fn in BENCHES:
+        if only and name not in only:
+            continue
+        try:
+            workloads.append(fn())
+        except Exception:  # one workload failing must not sink the rest
+            print(f"bench {name} FAILED:", file=sys.stderr)
+            traceback.print_exc()
+            workloads.append({"name": name, "error": "failed (see stderr)"})
+    head = next(
+        (w for w in workloads if w.get("name") == "mnist_mlp" and "value" in w),
+        None,
+    )
+    out = {
+        "metric": "mnist_mlp_train_throughput",
+        "value": head["value"] if head else 0.0,
+        "unit": "samples/sec",
+        "vs_baseline": round(head["value"] / BASELINE_SPS, 3) if head else 0.0,
+        "baseline_note": BASELINE_NOTE,
+        "workloads": workloads,
+    }
+    print(json.dumps(out))
+    # headline missing means the flagship workload failed (or was
+    # excluded by an explicit selection that omits it — that's fine)
+    if head is None and (not only or "mnist_mlp" in only):
+        return 1
     return 0
 
 

@@ -167,16 +167,17 @@ class LMDBReader:
         return self._pread(pgno * self.psize, self.psize)
 
     def _iter_page(
-        self, pgno: int, depth: int = 0
+        self, pgno: int, visits: list[int], depth: int = 0
     ) -> Iterator[tuple[bytes, bytes]]:
         # guard corrupt/crafted B+trees the same way the native walker
         # does (native/lmdbcodec.cc): a depth cap plus a visit budget of
         # one traversal per page in the file, so a branch-page cycle
-        # raises LMDBError instead of RecursionError
+        # raises LMDBError instead of RecursionError. The budget is local
+        # to each __iter__ call (concurrent iterators don't share it).
         if depth > 64:
             raise LMDBError(f"{self.path!r}: corrupt B+tree (depth > 64)")
-        self._visits += 1
-        if self._visits > max(1, self._size // self.psize):
+        visits[0] += 1
+        if visits[0] > max(1, self._size // self.psize):
             raise LMDBError(f"{self.path!r}: corrupt B+tree (page cycle)")
         page = self._page(pgno)
         _, _, flags, lower, _ = _PAGEHDR.unpack_from(page, 0)
@@ -190,7 +191,7 @@ class LMDBReader:
             for off in ptrs:
                 lo, hi, nflags, _ = _NODEHDR.unpack_from(page, off)
                 child = lo | (hi << 16) | (nflags << 32)
-                yield from self._iter_page(child, depth + 1)
+                yield from self._iter_page(child, visits, depth + 1)
         elif flags & P_LEAF:
             for off in ptrs:
                 lo, hi, nflags, ksize = _NODEHDR.unpack_from(page, off)
@@ -224,8 +225,7 @@ class LMDBReader:
     def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
         if self.meta.root == P_INVALID:
             return
-        self._visits = 0
-        yield from self._iter_page(self.meta.root)
+        yield from self._iter_page(self.meta.root, visits=[0])
 
     def close(self) -> None:
         self._f.close()

@@ -40,6 +40,10 @@ class Timers:
     def total(self, name: str) -> float:
         return self._acc.get(name, 0.0)
 
+    def phases(self) -> list[str]:
+        """Names of every phase that has accumulated time."""
+        return sorted(self._acc)
+
     def mean_ms(self, name: str) -> float:
         n = self._n.get(name, 0)
         return (self._acc.get(name, 0.0) / n * 1000.0) if n else 0.0
