@@ -132,11 +132,14 @@ def test_pooling_matches_reference():
             rtol=1e-5, atol=1e-6)
 
 
-def test_lrn_matches_chpool_formula():
+@pytest.mark.parametrize("beta", [0.75, 0.5, 1.0])
+def test_lrn_matches_chpool_formula(beta):
     # layer.cc:356-365: norm = chpool_sum(x^2,l)*alpha/l + knorm; x*norm^-beta
+    # beta parametrized to cover the rsqrt fast paths (0.75, 0.5) AND the
+    # generic power fallback
     rng = np.random.RandomState(2)
     x = rng.randn(2, 8, 3, 3).astype(np.float32)
-    lsize, alpha, beta, knorm = 5, 1e-4, 0.75, 1.0
+    lsize, alpha, knorm = 5, 1e-4, 1.0
     half = lsize // 2
     norm = np.zeros_like(x)
     for c in range(8):
