@@ -244,7 +244,10 @@ class AttentionConfig(Message):
 
     FIELDS = {
         "num_heads": Field("int", required=True),
-        "mode": Field("enum", "dense", enum=("dense", "flash")),
+        # "flash": Pallas kernel; "ring": sequence-parallel ring attention
+        # over the cluster's seq mesh axis (nseq_per_group), falling back
+        # to flash/dense when the mesh has no seq axis
+        "mode": Field("enum", "dense", enum=("dense", "flash", "ring")),
     }
 
 
@@ -256,6 +259,21 @@ class DenseConfig(Message):
         "num_output": Field("int", required=True),
         "activation": Field("enum", "", enum=("", "gelu", "relu")),
         "bias_term": Field("bool", True),
+    }
+
+
+class MoEConfig(Message):
+    """singa-tpu extension: Switch-style top-1 mixture-of-experts FFN
+    (kMoE). Expert weights shard over the cluster's expert mesh axis
+    (nexperts_per_group); the load-balancing aux loss joins the total
+    loss with weight aux_loss_weight. num_experts must be a multiple of
+    the expert axis width."""
+
+    FIELDS = {
+        "num_experts": Field("int", required=True),
+        "d_ff": Field("int", required=True),
+        "capacity_factor": Field("float", 1.25),
+        "aux_loss_weight": Field("float", 0.01),
     }
 
 
@@ -414,7 +432,14 @@ class LayerConfig(Message):
         "name": Field("string"),
         "type": Field("string"),
         "srclayers": Field("string", repeated=True),
-        "locationid": Field("int", 0),
+        # locationid is the reference's layer-placement field
+        # (base_layer.h:151-165: which thread/process hosts the layer).
+        # Here an explicitly-set locationid assigns the layer to a
+        # PIPELINE STAGE (graph/pipeline_plan.py) when the cluster conf
+        # declares npipes_per_group > 1. Default None = unplaced
+        # (prologue/epilogue, replicated); the reference's default is 0,
+        # which a conf may still write explicitly.
+        "locationid": Field("int", None),
         "partitionid": Field("int", 0),
         "partition_type": Field("enum", None, enum=PARTITION_TYPES),
         "share_ary": Field("string", repeated=True),
@@ -427,6 +452,7 @@ class LayerConfig(Message):
         "layernorm_param": Field("message", message=LayerNormConfig),
         "attention_param": Field("message", message=AttentionConfig),
         "dense_param": Field("message", message=DenseConfig),
+        "moe_param": Field("message", message=MoEConfig),
         "convolution_param": Field("message", message=ConvolutionConfig),
         "concate_param": Field("message", message=ConcateConfig),
         "data_param": Field("message", message=DataConfig),
@@ -564,6 +590,11 @@ class ModelConfig(Message):
         # fp32 (master copies, updater math in fp32); forward/backward
         # matmuls run in this dtype so the MXU sees bf16. "" = fp32. ---
         "compute_dtype": Field("string", ""),
+        # --- singa-tpu extension: microbatches per step for pipeline
+        # parallelism (layers staged by locationid over the cluster's
+        # pipe axis). 0 = the pipe width (the GPipe minimum); more
+        # microbatches shrink the fill/drain bubble. ---
+        "pipeline_microbatches": Field("int", 0),
     }
 
 
@@ -581,7 +612,38 @@ class ClusterConfig(Message):
         "synchronous": Field("bool", False),
         "largest_message": Field("int", 1048576),
         "bandwidth": Field("float", 100.0),
+        # ---- singa-tpu extensions: how nprocs_per_group splits across
+        # the intra-group parallelism axes. The reference's only
+        # intra-group axis is kLayerPartition (tensor/model); sequence
+        # (ring attention), expert (kMoE), and pipeline (locationid
+        # stages) are new. model width = nprocs_per_group /
+        # (nseq * nexperts * npipes); must divide evenly.
+        "nseq_per_group": Field("int", 1),
+        "nexperts_per_group": Field("int", 1),
+        "npipes_per_group": Field("int", 1),
     }
+
+    @property
+    def axis_widths(self) -> dict[str, int]:
+        """Mesh axis widths {data, pipe, expert, seq, model} implied by
+        the topology fields. See parallel.mesh.mesh_from_cluster."""
+        npg = max(1, self.nprocs_per_group)
+        nseq = max(1, self.nseq_per_group)
+        nexp = max(1, self.nexperts_per_group)
+        npipe = max(1, self.npipes_per_group)
+        inner = nseq * nexp * npipe
+        if npg % inner:
+            raise ConfigError(
+                f"nprocs_per_group ({npg}) not divisible by nseq*nexperts*"
+                f"npipes ({nseq}*{nexp}*{npipe}={inner})"
+            )
+        return {
+            "data": self.ngroups,
+            "pipe": npipe,
+            "expert": nexp,
+            "seq": nseq,
+            "model": npg // inner,
+        }
 
     @property
     def ngroups(self) -> int:

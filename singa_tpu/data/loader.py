@@ -178,6 +178,23 @@ def synthetic_arrays(
     return images, labels
 
 
+def structured_rgb(
+    n: int, classes: int = 10, seed: int = 0, noise_seed: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Spatially-structured synthetic RGB: kron-upsampled 8x8 class
+    templates (CIFAR-shaped 3x32x32). Weight-shared convs cannot
+    discriminate the iid-noise templates of synthetic_arrays (each pixel
+    independent), so conv-net convergence runs need low-frequency class
+    structure. ``noise_seed`` works like synthetic_arrays'."""
+    rng = np.random.RandomState(seed)
+    small = rng.rand(classes, 3, 8, 8) * 160
+    templates = np.kron(small, np.ones((1, 1, 4, 4)))
+    labels = (np.arange(n) % classes).astype(np.uint8)
+    nrng = rng if noise_seed is None else np.random.RandomState(noise_seed)
+    noise = nrng.rand(n, 3, 32, 32) * 95
+    return (templates[labels] + noise).clip(0, 255).astype(np.uint8), labels
+
+
 def load_label_lines(path: str) -> list[tuple[str, int]]:
     """Parse an ImageNet rid.txt label list: whitespace-separated
     "relative/img/path label" pairs (data_source.cc:109-127)."""

@@ -69,6 +69,10 @@ class Net:
     def __init__(self, layers: list[Layer], phase: str):
         self.phase = phase
         self.layers = layers
+        #: set by the trainer when the cluster declares a pipe axis and
+        #: the net places layers by locationid (graph/pipeline_plan.py)
+        self.pipeline_plan = None
+        self.pipeline_mesh = None
         self.name2layer = {l.name: l for l in layers}
         self.datalayers = [l for l in layers if l.is_datalayer]
         self.parserlayers = [l for l in layers if l.is_parserlayer]
@@ -98,6 +102,14 @@ class Net:
                 shapes[layer.name] = out
             layer.out_shape = out
         self.batchsize = batchsize
+
+    def bind_mesh(self, mesh) -> None:
+        """Attach the device mesh to every layer (static metadata read by
+        mesh-aware layers — ring attention, kMoE). The trainer calls this
+        once the mesh is resolved; nets built without a trainer keep
+        mesh=None and the layers' single-device fallbacks."""
+        for layer in self.layers:
+            layer.mesh = mesh
 
     def param_specs(self) -> dict[str, ParamSpec]:
         specs: dict[str, ParamSpec] = {}
@@ -165,7 +177,25 @@ class Net:
         slice_cursor: dict[str, int] = {}
         total_loss = jnp.float32(0.0)
         metrics: dict[str, dict[str, jnp.ndarray]] = {}
+        staged_names: set[str] = set()
+        if self.pipeline_plan is not None:
+            staged_names = {
+                l.name for st in self.pipeline_plan.stages for l in st
+            }
         for i, layer in enumerate(self.layers):
+            if layer.name in staged_names:
+                # the whole staged region executes as one GPipe schedule
+                # when its first layer is reached; later staged layers
+                # are already covered
+                plan = self.pipeline_plan
+                if layer is plan.stages[0][0]:
+                    from .pipeline_plan import pipeline_forward_region
+
+                    acts[plan.exits[-1]] = pipeline_forward_region(
+                        plan, resolved, acts[plan.entry_src],
+                        self.pipeline_mesh,
+                    )
+                continue
             if layer.is_datalayer:
                 inputs = [batch[layer.name]]
             else:
@@ -197,6 +227,12 @@ class Net:
                 total_loss = total_loss + loss
                 metrics[layer.name] = m
                 acts[layer.name] = loss
+            elif layer.has_aux_loss:
+                # e.g. kMoE load balancing: apply returns (out, aux);
+                # aux joins the total loss at the layer's declared weight
+                out, aux = out
+                total_loss = total_loss + layer.aux_weight * aux
+                acts[layer.name] = out
             else:
                 acts[layer.name] = out
         extra = []

@@ -33,6 +33,7 @@ from .sequence import (
     EmbeddingLayer,
     LayerNormLayer,
     LMLossLayer,
+    MoELayer,
     SequenceDataLayer,
 )
 from .neuron import (
@@ -73,8 +74,8 @@ def registered_types() -> list[str]:
 # the reference's 18 built-ins (neuralnet.cc:13-33) + extensions:
 # kSigmoid, kRBM + kEuclideanLoss (the CD/autoencoder path, BASELINE #4),
 # kBatchNorm/kAdd/kGlobalPooling (the ResNet vocabulary, BASELINE #5),
-# kSequenceData/kEmbedding/kLayerNorm/kAttention/kDense/kLMLoss (the
-# transformer-LM vocabulary — long-context as a config citizen)
+# kSequenceData/kEmbedding/kLayerNorm/kAttention/kDense/kLMLoss/kMoE (the
+# transformer-LM vocabulary — long-context + MoE as config citizens)
 for _cls in (
     RBMLayer,
     EuclideanLossLayer,
@@ -86,6 +87,7 @@ for _cls in (
     LayerNormLayer,
     AttentionLayer,
     DenseLayer,
+    MoELayer,
     LMLossLayer,
     ConvolutionLayer,
     ConcateLayer,

@@ -50,6 +50,9 @@ class Layer:
     is_parserlayer = False
     is_losslayer = False
     is_connectorlayer = False
+    #: layer's apply returns (out, aux_loss); Net.forward adds
+    #: layer.aux_weight * aux_loss to the total (kMoE load balancing)
+    has_aux_loss = False
 
     def __init__(self, cfg: LayerConfig, net_partition: str = "kNone"):
         self.cfg = cfg
@@ -59,6 +62,10 @@ class Layer:
         self.out_shape: Shape | None = None
         self._param_specs: dict[str, ParamSpec] = {}
         self._buffer_specs: dict[str, BufferSpec] = {}
+        #: device mesh, bound by the trainer (Net.bind_mesh) — static
+        #: metadata for layers whose compute is mesh-aware (ring
+        #: attention's seq axis, kMoE's expert axis); None = single-device
+        self.mesh = None
 
     # ---------------- build time ----------------
 
@@ -82,6 +89,7 @@ class Layer:
         shape: Shape,
         fan_in: int = 0,
         neuron_axis: int | None = None,
+        expert_axis: int | None = None,
     ) -> str:
         """Register param ``<layer>/<name>`` from cfg.param[idx] (if given)."""
         cfg = self.cfg.param[idx] if idx < len(self.cfg.param) else None
@@ -96,6 +104,7 @@ class Layer:
             fan_in=fan_in,
             owner=owner,
             neuron_axis=neuron_axis,
+            expert_axis=expert_axis,
         )
         return qualified
 

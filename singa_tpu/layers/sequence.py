@@ -156,6 +156,13 @@ class AttentionLayer(Layer):
         )
         return src
 
+    def _seq_mesh(self):
+        """The bound mesh, when it carries a >1-wide seq axis."""
+        mesh = self.mesh
+        if mesh is not None and dict(mesh.shape).get("seq", 1) > 1:
+            return mesh
+        return None
+
     def apply(self, params, inputs, *, training, rng=None):
         x = inputs[0]
         b, s, d = x.shape
@@ -164,7 +171,14 @@ class AttentionLayer(Layer):
             b, s, 3, self.heads, d // self.heads
         )
         q, k, v = (jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3))
-        if self.mode == "flash":
+        if self.mode == "ring" and self._seq_mesh() is not None:
+            # sequence parallelism: K/V shards rotate the seq mesh axis
+            # (parallel/ring.py); with no seq axis the mode degrades to
+            # flash below — same math, single shard
+            from ..parallel.ring import ring_attention
+
+            o = ring_attention(q, k, v, self._seq_mesh(), causal=True)
+        elif self.mode in ("flash", "ring"):
             o = flash_attention(q, k, v, True)
         else:
             o = attention(q, k, v, causal=True)
@@ -204,6 +218,76 @@ class DenseLayer(Layer):
         elif self.activation == "relu":
             out = jax.nn.relu(out)
         return out
+
+
+class MoELayer(Layer):
+    """kMoE: Switch-style top-1 mixture-of-experts FFN (singa-tpu
+    extension — the reference predates MoE entirely).
+
+    Expert weights carry expert_axis metadata, so param_shardings splits
+    them over the cluster's expert mesh axis (nexperts_per_group); the
+    compute then runs expert-parallel through parallel/moe.py's
+    shard_map (local dispatch -> local experts -> psum combine). On a
+    mesh without an expert axis the dense single-device path runs — the
+    same math. The Switch load-balancing aux loss rides Net.forward's
+    aux-loss channel with weight moe_param.aux_loss_weight."""
+
+    TYPE = "kMoE"
+    has_aux_loss = True
+
+    def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
+        p = self.cfg.moe_param
+        if p is None:
+            raise ConfigError(f"layer {self.name!r}: moe_param required")
+        src = require_one_src(self, src_shapes)
+        if len(src) != 3:
+            raise ConfigError(
+                f"layer {self.name!r}: expects (batch, seq, dim) input"
+            )
+        d = src[-1]
+        self.n_experts = p.num_experts
+        self.d_ff = p.d_ff
+        self.capacity_factor = p.capacity_factor
+        self.aux_weight = p.aux_loss_weight
+        self.gate = self._declare_param(0, "gate", (d, self.n_experts),
+                                        fan_in=d)
+        self.up = self._declare_param(
+            1, "up", (self.n_experts, d, self.d_ff),
+            fan_in=d, expert_axis=0,
+        )
+        self.down = self._declare_param(
+            2, "down", (self.n_experts, self.d_ff, d),
+            fan_in=self.d_ff, expert_axis=0,
+        )
+        return src
+
+    def _expert_mesh(self):
+        mesh = self.mesh
+        if mesh is not None and dict(mesh.shape).get("expert", 1) > 1:
+            return mesh
+        return None
+
+    def apply(self, params, inputs, *, training, rng=None):
+        from ..parallel.moe import moe_ffn, moe_ffn_dense
+
+        x = inputs[0]
+        p = {
+            "gate": params[self.gate],
+            "up": params[self.up],
+            "down": params[self.down],
+        }
+        mesh = self._expert_mesh()
+        if mesh is not None:
+            nexp = dict(mesh.shape)["expert"]
+            if self.n_experts % nexp:
+                raise ConfigError(
+                    f"layer {self.name!r}: num_experts {self.n_experts} "
+                    f"not divisible by expert axis width {nexp}"
+                )
+            return moe_ffn(
+                x, p, mesh, capacity_factor=self.capacity_factor
+            )
+        return moe_ffn_dense(x, p, self.capacity_factor)
 
 
 class LMLossLayer(Layer):

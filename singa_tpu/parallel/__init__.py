@@ -18,7 +18,13 @@ from .consistency import (
     sync_ratio,
 )
 from .launch import coordinator_address, init_distributed, read_hostfile
-from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, mesh_from_cluster
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    build_full_mesh,
+    build_mesh,
+    mesh_from_cluster,
+)
 from .moe import (
     build_ep_mesh,
     init_moe,
@@ -37,6 +43,7 @@ from .shardings import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "build_full_mesh",
     "build_mesh",
     "mesh_from_cluster",
     "coordinator_address",

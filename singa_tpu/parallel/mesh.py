@@ -65,18 +65,44 @@ def axis_pair_mesh(
     return Mesh(grid, ("data", axis))
 
 
+#: full mesh axis order: model innermost (its collectives are densest),
+#: then the seq ring, expert all-to-all, pipe hops, data outermost
+FULL_AXES = ("data", "pipe", "expert", "seq", "model")
+
+
+def build_full_mesh(widths: dict[str, int], devices=None) -> Mesh:
+    """Build the 5-axis (data, pipe, expert, seq, model) mesh.
+
+    Unused axes have width 1 and cost nothing; shardings that only name
+    data/model behave exactly as on the 2-axis mesh."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    shape = tuple(max(1, widths.get(a, 1)) for a in FULL_AXES)
+    need = int(np.prod(shape))
+    if need > len(devices):
+        raise ConfigError(
+            f"mesh wants {dict(zip(FULL_AXES, shape))} = {need} devices, "
+            f"only {len(devices)} visible"
+        )
+    grid = np.array(devices[:need]).reshape(shape)
+    return Mesh(grid, FULL_AXES)
+
+
 def mesh_from_cluster(
     cluster: ClusterConfig | None, devices=None
 ) -> Mesh:
     """Map the reference cluster topology onto a device mesh.
 
-    ngroups -> data axis, nprocs_per_group -> model axis
-    (include/utils/cluster.h:49-60). With no cluster config, every visible
-    device joins the data axis — the common pure-DP case.
+    ngroups -> data axis, nprocs_per_group -> intra-group axes
+    (include/utils/cluster.h:49-60): by default all of it is the model
+    axis (kLayerPartition); the extension fields nseq_per_group /
+    nexperts_per_group / npipes_per_group carve seq/expert/pipe widths
+    out of it (ClusterConfig.axis_widths). With no cluster config, every
+    visible device joins the data axis — the common pure-DP case.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     if cluster is None or not cluster.nworkers:
         return build_mesh(len(devices), 1, devices)
-    nmodel = max(1, cluster.nprocs_per_group)
-    ndata = cluster.ngroups
-    return build_mesh(ndata, nmodel, devices)
+    widths = cluster.axis_widths
+    if all(widths[a] == 1 for a in ("pipe", "expert", "seq")):
+        return build_mesh(widths["data"], widths["model"], devices)
+    return build_full_mesh(widths, devices)

@@ -97,7 +97,7 @@ def ring_attention(
     axis the mesh has. Differentiable: autodiff traces back through the
     ppermute rotations, so grads flow with the same ring traffic pattern.
     """
-    if mesh.shape[axis] == 1:
+    if dict(mesh.shape).get(axis, 1) == 1:
         from ..ops.attention import attention
 
         return attention(q, k, v, causal=causal)

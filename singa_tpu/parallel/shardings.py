@@ -35,12 +35,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_shardings(mesh: Mesh, net: Net) -> dict:
     """Sharding pytree for the step's batch input: every array in every
-    data layer's feed dict is sharded on dim 0 over the data axis."""
+    data layer's feed dict is sharded on dim 0 over the data axis. Token
+    feeds additionally shard their sequence dim over the seq axis when
+    the mesh has one (sequence parallelism — ring attention then keeps
+    K/V sharded end to end)."""
     leaf = NamedSharding(mesh, P(DATA_AXIS))
-    return {
-        layer.name: {"image": leaf, "label": leaf}
-        for layer in net.datalayers
-    }
+    nseq = dict(mesh.shape).get("seq", 1)
+    out = {}
+    for layer in net.datalayers:
+        img = leaf
+        if nseq > 1 and layer.TYPE == "kSequenceData":
+            img = NamedSharding(mesh, P(DATA_AXIS, "seq"))
+        out[layer.name] = {"image": img, "label": leaf}
+    return out
 
 
 def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
@@ -52,6 +59,7 @@ def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
     loss is a mean over the sharded batch dim).
     """
     nmodel = mesh.shape[MODEL_AXIS]
+    nexpert = dict(mesh.shape).get("expert", 1)
     out: dict[str, NamedSharding] = {}
     for layer in net.layers:
         for name, spec in layer.param_specs().items():
@@ -64,6 +72,17 @@ def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
             ):
                 axes: list = [None] * len(spec.shape)
                 axes[spec.neuron_axis] = MODEL_AXIS
+                sharding = NamedSharding(mesh, P(*axes))
+            elif (
+                spec.expert_axis is not None
+                and nexpert > 1
+                and spec.shape[spec.expert_axis] % nexpert == 0
+            ):
+                # kMoE expert weights split over the expert axis
+                # regardless of partition_type — expert parallelism is
+                # the layer's intrinsic layout, not a net-wide choice
+                axes = [None] * len(spec.shape)
+                axes[spec.expert_axis] = "expert"
                 sharding = NamedSharding(mesh, P(*axes))
             out[name] = sharding
     return out

@@ -45,6 +45,10 @@ class ParamSpec:
     # the *blob*; per-param this is dim 1 for FC weights, dim 0 for conv
     # filters/biases). None = never model-sharded.
     neuron_axis: int | None = None
+    # Which array axis enumerates experts (kMoE weights) — sharded over
+    # the mesh's expert axis (singa-tpu extension; the reference has no
+    # MoE). None = not expert-sharded.
+    expert_axis: int | None = None
 
     @classmethod
     def from_config(
@@ -55,6 +59,7 @@ class ParamSpec:
         fan_in: int = 0,
         owner: str | None = None,
         neuron_axis: int | None = None,
+        expert_axis: int | None = None,
     ) -> "ParamSpec":
         if cfg is None:
             return cls(
@@ -63,6 +68,7 @@ class ParamSpec:
                 fan_in=fan_in,
                 owner=owner,
                 neuron_axis=neuron_axis,
+                expert_axis=expert_axis,
             )
         return cls(
             name=name,
@@ -78,6 +84,7 @@ class ParamSpec:
             fan_in=fan_in,
             owner=owner,
             neuron_axis=neuron_axis,
+            expert_axis=expert_axis,
         )
 
 

@@ -1,0 +1,122 @@
+"""Full-length convergence runs of the example job configs.
+
+The reference's workloads define the parity bar: MNIST MLP 60k steps
+(reference examples/mnist/mlp.conf:2, ~98% top-1), LeNet 10k steps
+(conv.conf:2, ~99%), CIFAR AlexNet 70k steps (~80%). Real MNIST/CIFAR
+cannot be downloaded in this zero-egress image (documented in
+BASELINE.md), so each run uses the best available stand-in at FULL
+reference length and width:
+
+  mlp / conv  sklearn digits upscaled to 28x28 (1438 train / 359 test)
+  alexnet     structured synthetic RGB (kron-upsampled class templates,
+              5000 train / 1000 test with disjoint noise)
+
+Usage:  python -m singa_tpu.tools.convergence [mlp conv alexnet]
+
+Prints one JSON line per workload: {name, steps, wall_sec,
+steps_per_sec, final_test_accuracy, final_test_loss} — the convergence
+table in BASELINE.md records these.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+
+
+def _digits_shards(tmp: str) -> tuple[str, str]:
+    from ..data.loader import digits_arrays, write_records
+
+    train = os.path.join(tmp, "train_shard")
+    test = os.path.join(tmp, "test_shard")
+    write_records(train, *digits_arrays("train"))
+    write_records(test, *digits_arrays("test"))
+    return train, test
+
+
+def _cifar_shards(tmp: str) -> tuple[str, str, str]:
+    from ..data.loader import compute_mean, structured_rgb, write_records
+
+    train = os.path.join(tmp, "train_shard")
+    test = os.path.join(tmp, "test_shard")
+    write_records(train, *structured_rgb(5000, seed=0, noise_seed=1))
+    write_records(test, *structured_rgb(1000, seed=0, noise_seed=2))
+    mean = os.path.join(tmp, "mean.npy")
+    compute_mean(train, mean)
+    return train, test, mean
+
+
+def _patch_paths(cfg, train: str, test: str, mean: str | None = None):
+    for layer in cfg.neuralnet.layer:
+        if layer.data_param is not None and layer.data_param.path:
+            is_test = "kTrain" in (layer.exclude or [])
+            layer.data_param.path = test if is_test else train
+        p = getattr(layer, "rgbimage_param", None)
+        if mean is not None and p is not None and p.meanfile:
+            p.meanfile = mean
+
+
+def run_workload(name: str, log=print) -> dict:
+    from ..config import load_model_config
+    from ..trainer import Trainer
+
+    tmp = tempfile.mkdtemp(prefix=f"singa_tpu_conv_{name}_")
+    if name == "mlp":
+        cfg = load_model_config(
+            os.path.join(REPO, "examples", "mnist", "mlp.conf")
+        )
+        _patch_paths(cfg, *_digits_shards(tmp))
+    elif name == "conv":
+        cfg = load_model_config(
+            os.path.join(REPO, "examples", "mnist", "conv.conf")
+        )
+        _patch_paths(cfg, *_digits_shards(tmp))
+    elif name == "alexnet":
+        cfg = load_model_config(
+            os.path.join(REPO, "examples", "cifar10", "alexnet.conf")
+        )
+        train, test, mean = _cifar_shards(tmp)
+        _patch_paths(cfg, train, test, mean)
+    else:
+        raise ValueError(f"unknown workload {name!r}")
+    cfg.checkpoint_frequency = 0  # no workspace configured for these runs
+
+    trainer = Trainer(cfg, seed=0, log=log, prefetch=False)
+    t0 = time.perf_counter()
+    trainer.run()
+    wall = time.perf_counter() - t0
+    # final accuracy over the full test stream (enough steps to cover it)
+    pipe = next(iter(trainer._pipelines[id(trainer.test_net)].values()))
+    nsteps = max(1, int(np.ceil(pipe.n / pipe.batchsize)))
+    final = trainer.evaluate(
+        trainer.test_net, nsteps, "final-test", cfg.train_steps
+    )
+    (m,) = final.values()
+    return {
+        "name": name,
+        "steps": cfg.train_steps,
+        "wall_sec": round(wall, 1),
+        "steps_per_sec": round(cfg.train_steps / wall, 1),
+        "final_test_accuracy": round(float(m["precision"]), 4),
+        "final_test_loss": round(float(m["loss"]), 4),
+    }
+
+
+def main(argv: list[str]) -> int:
+    names = argv or ["mlp", "conv", "alexnet"]
+    quiet = lambda s: None  # noqa: E731
+    for name in names:
+        result = run_workload(name, log=quiet)
+        print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

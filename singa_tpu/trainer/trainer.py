@@ -94,6 +94,18 @@ class Trainer:
 
         # --- mesh + shardings (replaces Cluster/PS/partitioner) ---
         self.mesh = mesh if mesh is not None else mesh_from_cluster(cluster_cfg)
+        npipe = dict(self.mesh.shape).get("pipe", 1)
+        for net in (self.train_net, self.test_net, self.val_net):
+            if net is None:
+                continue
+            net.bind_mesh(self.mesh)
+            if npipe > 1:
+                from ..graph.pipeline_plan import plan_stages
+
+                net.pipeline_plan = plan_stages(
+                    net, npipe, model_cfg.pipeline_microbatches
+                )
+                net.pipeline_mesh = self.mesh
         self.param_sh = param_shardings(self.mesh, self.train_net)
         self.state_sh = state_shardings(self.param_sh, self.updater.SLOTS)
         self.batch_sh = batch_shardings(self.mesh, self.train_net)

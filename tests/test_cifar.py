@@ -10,6 +10,7 @@ from singa_tpu.config import load_model_config, parse_cluster_config
 from singa_tpu.data.loader import (
     compute_mean,
     read_cifar_bins,
+    structured_rgb,
     synthetic_arrays,
     write_records,
 )
@@ -18,20 +19,6 @@ from singa_tpu.graph.builder import build_net
 from singa_tpu.trainer import Trainer
 
 REPO = os.path.join(os.path.dirname(__file__), "..")
-
-
-def structured_rgb(n, classes=10, seed=0, noise_seed=None):
-    """Spatially-structured synthetic RGB: kron-upsampled 8x8 class
-    templates. Weight-shared convs cannot discriminate the iid-noise
-    templates of synthetic_arrays (each pixel independent), so conv-net
-    tests need low-frequency class structure."""
-    rng = np.random.RandomState(seed)
-    small = rng.rand(classes, 3, 8, 8) * 160
-    templates = np.kron(small, np.ones((1, 1, 4, 4)))
-    labels = (np.arange(n) % classes).astype(np.uint8)
-    nrng = rng if noise_seed is None else np.random.RandomState(noise_seed)
-    noise = nrng.rand(n, 3, 32, 32) * 95
-    return (templates[labels] + noise).clip(0, 255).astype(np.uint8), labels
 
 
 def fake_cifar_bin(path, n, seed=0):
