@@ -133,18 +133,36 @@ class BatchPipeline:
         self.labels = labels
         self.batchsize = batchsize
         self.n = len(images)
-        self._pos = 0
+        self._pos = 0  # producer cursor (runs ahead under prefetch)
         if random_skip:
             rng = np.random.RandomState(seed)
             self._pos = int(rng.randint(0, random_skip)) % self.n
+        # CONSUMED position bookkeeping: position is derived from batches
+        # actually handed to the trainer, not the producer cursor — under
+        # prefetch the queue holds batches the trainer never saw, and a
+        # checkpoint must not skip those on resume.
+        self._start = self._pos
+        self._consumed = 0
         self._prefetch = prefetch
         self._queue: queue.Queue | None = None
         self._thread: threading.Thread | None = None
 
     @property
     def position(self) -> int:
-        """Current stream position (record index of the next batch)."""
-        return self._pos
+        """Stream position (record index of the next batch the TRAINER
+        will see). Checkpoints persist this; seek() restores it. The
+        one-time random_skip draw is baked into it, so resume needs no
+        separate RNG state."""
+        return int((self._start + self._consumed * self.batchsize) % self.n)
+
+    def seek(self, pos: int) -> None:
+        """Reposition the stream (checkpoint resume). Must happen before
+        the prefetch thread starts."""
+        if self._thread is not None:
+            raise RuntimeError("seek() after prefetch started")
+        self._pos = int(pos) % self.n
+        self._start = self._pos
+        self._consumed = 0
 
     def advance(self, nsteps: int) -> None:
         """Skip ``nsteps`` batches: the device-side chunk engine consumed
@@ -152,6 +170,7 @@ class BatchPipeline:
         if self._thread is not None:
             raise RuntimeError("advance() after prefetch started")
         self._pos = int((self._pos + nsteps * self.batchsize) % self.n)
+        self._consumed += nsteps
 
     def _next_indices(self) -> np.ndarray:
         idx = (self._pos + np.arange(self.batchsize)) % self.n
@@ -164,7 +183,9 @@ class BatchPipeline:
         device). Do not mix with a running prefetch thread."""
         if self._thread is not None:
             raise RuntimeError("next_indices() after prefetch started")
-        return self._next_indices()
+        idx = self._next_indices()
+        self._consumed += 1
+        return idx
 
     def next_batch(self) -> tuple[np.ndarray, np.ndarray]:
         if self._prefetch:
@@ -174,8 +195,11 @@ class BatchPipeline:
                     target=self._producer, daemon=True
                 )
                 self._thread.start()
-            return self._queue.get()
+            item = self._queue.get()
+            self._consumed += 1
+            return item
         idx = self._next_indices()
+        self._consumed += 1
         return self.images[idx], self.labels[idx]
 
     def _producer(self) -> None:

@@ -10,8 +10,15 @@ parsed but never honored. Here they are:
     atomically (tmp file + rename) so a crash mid-write never corrupts the
     latest checkpoint — the same torn-write discipline as
     Shard::PrepareForAppend (src/utils/shard.cc:175-206).
+  - data-stream positions ride along ("d|<phase>|<layer>" keys): each
+    pipeline's CONSUMED position, so a resumed run continues the stream
+    exactly where training stopped instead of silently replaying from
+    the shard start. The one-time random_skip draw is baked into the
+    position, so no RNG state needs separate persistence.
   - restore ModelConfig.checkpoint -> params/state/step before training;
-    kPretrained params take their value from it.
+    kPretrained params take their value from it. Checkpoints written
+    before the stream section simply restore with no positions (stream
+    starts over — the old behavior).
 """
 
 from __future__ import annotations
@@ -26,6 +33,7 @@ _STEP_KEY = "__step__"
 _P = "p|"  # param arrays
 _S = "s|"  # updater slot arrays, "s|<param>|<slot>"
 _B = "b|"  # buffer arrays (stateful-layer state, e.g. BN running stats)
+_D = "d|"  # data-stream positions, "d|<phase>|<layer>"
 
 
 def save_checkpoint(
@@ -34,6 +42,7 @@ def save_checkpoint(
     params: dict[str, jnp.ndarray],
     state: dict[str, dict[str, jnp.ndarray]] | None = None,
     buffers: dict[str, jnp.ndarray] | None = None,
+    streams: dict[str, int] | None = None,
 ) -> str:
     """Atomic .npz snapshot; returns the final path."""
     arrays: dict[str, np.ndarray] = {_STEP_KEY: np.int64(step)}
@@ -44,6 +53,8 @@ def save_checkpoint(
             arrays[f"{_S}{name}|{slot}"] = np.asarray(arr)
     for name, arr in (buffers or {}).items():
         arrays[_B + name] = np.asarray(arr)
+    for name, pos in (streams or {}).items():
+        arrays[_D + name] = np.int64(pos)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(path) or ".", suffix=".tmp"
@@ -66,7 +77,9 @@ def load_checkpoint(
     dict[str, dict[str, np.ndarray]],
     dict[str, np.ndarray],
 ]:
-    """-> (step, params, state, buffers)."""
+    """-> (step, params, state, buffers). Stream positions via
+    load_stream_positions (kept out of this signature for the callers
+    that only want arrays)."""
     with np.load(path) as z:
         step = int(z[_STEP_KEY])
         params: dict[str, np.ndarray] = {}
@@ -81,6 +94,17 @@ def load_checkpoint(
             elif key.startswith(_B):
                 buffers[key[len(_B):]] = z[key]
     return step, params, state, buffers
+
+
+def load_stream_positions(path: str) -> dict[str, int]:
+    """-> {"<phase>|<layer>": consumed position} from the checkpoint
+    (empty for checkpoints written before the stream section existed)."""
+    with np.load(path) as z:
+        return {
+            key[len(_D):]: int(z[key])
+            for key in z.files
+            if key.startswith(_D)
+        }
 
 
 def restore_into(

@@ -322,9 +322,12 @@ class ReplicaTrainer(Trainer):
     def _resume(self, path: str) -> None:
         import os
 
-        from .checkpoint import restore_into
+        from .checkpoint import load_stream_positions, restore_into
 
         step, params, state, _ = restore_into(path, self.params, self.state)
+        # stream positions: consumed by the base __init__ when it builds
+        # the pipelines, same as the sync trainer's resume path
+        self._resume_streams = load_stream_positions(path)
         self.start_step = max(self.start_step, step)
         # restore_into returns uncommitted host arrays — put them back on
         # the replica shardings or the donating jit compiles unsharded

@@ -38,7 +38,11 @@ from ..parallel import (
 )
 from ..params import init_params
 from ..utils import Performance, Timers, dump_net_json
-from .checkpoint import restore_into, save_checkpoint
+from .checkpoint import (
+    load_stream_positions,
+    restore_into,
+    save_checkpoint,
+)
 
 
 def _now(step: int, freq: int, after: int) -> bool:
@@ -144,6 +148,16 @@ class Trainer:
                 )
                 for l in net.datalayers
             }
+            # resume: restore each stream to its checkpointed consumed
+            # position (completing the Worker::Resume contract — a
+            # resumed run continues the data stream, it doesn't replay
+            # from the shard start)
+            for name, pipe in self._pipelines[id(net)].items():
+                pos = getattr(self, "_resume_streams", {}).get(
+                    f"{net.phase}|{name}"
+                )
+                if pos is not None:
+                    pipe.seek(pos)
 
         # --- device-resident dataset fast path ---
         # When every data layer's decoded shard fits the budget, upload it
@@ -194,10 +208,13 @@ class Trainer:
         params = init_params(self._init_key, self.specs)
         state = self.updater.init_state(params)
         buffers = self.train_net.init_buffers()
+        #: stream positions waiting to be applied once pipelines exist
+        self._resume_streams: dict[str, int] = {}
         if self.cfg.checkpoint:
             ck_step, params, state, buffers = restore_into(
                 self.cfg.checkpoint, params, state, buffers
             )
+            self._resume_streams = load_stream_positions(self.cfg.checkpoint)
             self.start_step = max(self.start_step, ck_step)
             self.log(
                 f"resumed from {self.cfg.checkpoint} at step {self.start_step}"
@@ -590,12 +607,24 @@ class Trainer:
             return os.path.join(self.cluster.workspace, "checkpoints")
         return None
 
+    def _stream_positions(self) -> dict[str, int]:
+        out = {}
+        for net in (self.train_net, self.test_net, self.val_net):
+            if net is None:
+                continue
+            for name, pipe in self._pipelines[id(net)].items():
+                out[f"{net.phase}|{name}"] = pipe.position
+        return out
+
     def save(self, step: int) -> str | None:
         folder = self._checkpoint_dir()
         if folder is None:
             return None
         path = os.path.join(folder, f"step_{step}.npz")
-        save_checkpoint(path, step, self.params, self.state, self.buffers)
+        save_checkpoint(
+            path, step, self.params, self.state, self.buffers,
+            streams=self._stream_positions(),
+        )
         self.log(f"step {step}: checkpoint -> {path}")
         return path
 

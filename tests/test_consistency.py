@@ -339,8 +339,9 @@ class TestReplicaTrainer:
             prefetch=False,
         )
         assert t_c.start_step == 8 and t_c._bootstrapped
+        # stream positions ride in the checkpoint (no manual surgery)
         for pipe in t_c._pipelines[id(t_c.train_net)].values():
-            pipe._pos = (8 * 4 * 64) % pipe.n
+            assert pipe.position == (8 * 4 * 64) % pipe.n
         t_c.run()
 
         for name in t_a.params:

@@ -240,3 +240,32 @@ def test_pipeline_prefetch_thread():
     seen = [p.next_batch()[1] for _ in range(4)]
     np.testing.assert_array_equal(np.concatenate(seen) % 8,
                                   np.tile(np.arange(8), 2))
+
+
+def test_pipeline_position_counts_consumed_not_produced():
+    """Under prefetch the producer thread runs ahead; the checkpointed
+    position must reflect batches the trainer actually received, or a
+    resume would skip the queued-but-unconsumed ones."""
+    import time
+
+    images = np.arange(64, dtype=np.float32).reshape(64, 1)
+    labels = np.arange(64, dtype=np.int32)
+    p = BatchPipeline(images, labels, batchsize=4, prefetch=True)
+    for _ in range(3):
+        p.next_batch()
+    time.sleep(0.2)  # let the producer fill its queue past the consumer
+    assert p.position == 12
+    assert p._pos > 12  # producer genuinely ran ahead
+
+
+def test_pipeline_seek_restores_stream():
+    images = np.arange(10, dtype=np.float32).reshape(10, 1)
+    labels = np.arange(10, dtype=np.int32)
+    p = BatchPipeline(images, labels, batchsize=3, prefetch=False,
+                      random_skip=7, seed=0)
+    p.next_batch()
+    saved = p.position
+    q = BatchPipeline(images, labels, batchsize=3, prefetch=False)
+    q.seek(saved)
+    np.testing.assert_array_equal(q.next_batch()[1], p.next_batch()[1])
+    assert q.position == p.position

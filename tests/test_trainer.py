@@ -175,10 +175,10 @@ def test_checkpoint_resume_reproduces_uninterrupted_run(tmp_path):
     cfg_c.checkpoint = ckpt
     t_c = Trainer(cfg_c, seed=3, log=lambda s: None, prefetch=False)
     assert t_c.start_step == 10
-    # resume consumes batches from the shard start; align the pipeline to
-    # where run a left off (10 steps into the stream) for bitwise replay
+    # stream positions ride in the checkpoint: the resumed run continues
+    # the data stream exactly where step 10 left it — no manual surgery
     for pipe in t_c._pipelines[id(t_c.train_net)].values():
-        pipe._pos = (10 * 64) % pipe.n
+        assert pipe.position == (10 * 64) % pipe.n
     t_c.run()
 
     for name in t_a.params:
