@@ -27,3 +27,14 @@ import sys
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test (deselect with -m 'not slow' for the "
+        "fast core signal)",
+    )
+
+
+collect_ignore = ["mp_worker.py"]

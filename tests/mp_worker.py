@@ -1,0 +1,83 @@
+"""Subprocess body for the multi-process integration test.
+
+Drives the REAL CLI entry (singa_tpu.main.main) — the analog of the
+reference actually launching ``build/singa -procsID=N -hostfile ...`` on
+each host (examples/mnist/run.sh:19-37) — then dumps the trained params
+and run metadata for the parent test to compare across ranks.
+
+Usage: python mp_worker.py <procsid> <model_conf> <cluster_conf> \
+           <hostfile> <out_npz>
+"""
+
+import json
+import os
+import sys
+
+# CPU platform, pinned BEFORE jax import (each process contributes its
+# one CPU device to the 2-process global mesh). The env var alone is not
+# enough on this image — sitecustomize re-pins the tunneled accelerator,
+# so pin again through jax.config (same dance as tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def run() -> int:
+    procsid, model_conf, cluster_conf, hostfile, out = sys.argv[1:6]
+
+    import numpy as np
+
+    import singa_tpu.main as cli
+    import singa_tpu.trainer as trainer_mod
+
+    captured = {}
+    real_make = trainer_mod.make_trainer
+
+    def capturing_make(*args, **kwargs):
+        t = real_make(*args, **kwargs)
+        captured["trainer"] = t
+        return t
+
+    cli.make_trainer = capturing_make
+    rc = cli.main([
+        "-model_conf", model_conf,
+        "-cluster_conf", cluster_conf,
+        "-procsID", procsid,
+        "-hostfile", hostfile,
+    ])
+    if rc != 0:
+        return rc
+
+    import jax
+
+    t = captured["trainer"]
+    arrays = {n: np.asarray(v) for n, v in t.params.items()}
+    np.savez(out + ".tmp.npz", **arrays)
+    os.replace(out + ".tmp.npz", out)
+    meta = {
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "mesh": dict(t.mesh.shape),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "batch_shard_ok": _batch_sharded(t),
+    }
+    with open(out + ".json", "w") as f:
+        json.dump(meta, f)
+    return 0
+
+
+def _batch_sharded(t) -> bool:
+    """Per-process data sharding: the train batch's sharding must split
+    dim 0 over the data axis (each rank computes its own half)."""
+    sh = next(iter(t.batch_sh.values()))["image"]
+    return tuple(sh.spec)[:1] == ("data",)
+
+
+if __name__ == "__main__":
+    sys.exit(run())
