@@ -586,6 +586,10 @@ class ModelConfig(Message):
         "checkpoint": Field("string"),
         "checkpoint_frequency": Field("int", 0),
         "checkpoint_after_steps": Field("int", 0),
+        # "npz": one gathered file (small models); "sharded": per-process
+        # shard files, arrays stay device-sharded end to end (pods) —
+        # restore auto-detects the format from the path
+        "checkpoint_format": Field("enum", "npz", enum=("npz", "sharded")),
         # --- singa-tpu extension: mixed-precision compute. Params stay
         # fp32 (master copies, updater math in fp32); forward/backward
         # matmuls run in this dtype so the MXU sees bf16. "" = fp32. ---

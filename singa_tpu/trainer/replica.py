@@ -323,13 +323,40 @@ class ReplicaTrainer(Trainer):
         import os
 
         from .checkpoint import load_stream_positions, restore_into
+        from .sharded_ckpt import is_sharded_checkpoint
 
-        step, params, state, _ = restore_into(path, self.params, self.state)
-        # stream positions: consumed by the base __init__ when it builds
-        # the pipelines, same as the sync trainer's resume path
-        self._resume_streams = load_stream_positions(path)
+        if is_sharded_checkpoint(path):
+            # replica state is small (it must fit every replica on one
+            # chip), so the host-assemble reader suffices here — the
+            # placement still lands on the replica shardings
+            from .sharded_ckpt import ShardedCheckpoint, param_key, state_key
+
+            with ShardedCheckpoint(path) as ck:
+                have = set(ck.keys())
+                step = ck.step
+                params = {
+                    n: ck.assemble(param_key(n))
+                    if param_key(n) in have else v
+                    for n, v in self.params.items()
+                }
+                state = {
+                    n: {
+                        s: ck.assemble(state_key(n, s))
+                        if state_key(n, s) in have else v
+                        for s, v in slots.items()
+                    }
+                    for n, slots in self.state.items()
+                }
+                self._resume_streams = dict(ck.streams)
+        else:
+            step, params, state, _ = restore_into(
+                path, self.params, self.state
+            )
+            # stream positions: consumed by the base __init__ when it
+            # builds the pipelines, same as the sync trainer's resume path
+            self._resume_streams = load_stream_positions(path)
         self.start_step = max(self.start_step, step)
-        # restore_into returns uncommitted host arrays — put them back on
+        # the readers return uncommitted host arrays — put them back on
         # the replica shardings or the donating jit compiles unsharded
         self.params = {
             n: jax.device_put(v, self._rep_param_sh[n])

@@ -1,0 +1,277 @@
+"""Sharded checkpointing: per-process shard files, no host funnel.
+
+The single-file .npz checkpoint (checkpoint.py) gathers every array to
+one host — fine for MNIST, wrong for ResNet-50 on a pod: the gather
+funnels the full model through one process's memory and one file's
+bandwidth. This format writes what each PROCESS already holds:
+
+  <dir>/manifest.json      step, stream positions, per-array metadata
+                           (shape, dtype, PartitionSpec) — process 0
+  <dir>/proc_<k>.npz       process k's addressable shards, one entry per
+                           (array, device) with its global index box
+
+Save never materializes a global array: each device shard's data moves
+device->host individually (replica 0 only, so replicated arrays cost
+one copy total across the job). Restore places shards directly back
+onto their devices via jax.make_array_from_single_device_arrays when
+the target sharding matches the saved one — the array is never
+assembled on any host — and falls back to host assembly + device_put
+when the mesh or spec changed between save and restore.
+
+This is the pod-scale completion of the reference's never-used
+BlobProto/tensor_io serialization (src/proto/model.proto:342-349,
+include/mshadow/tensor_io.h:39-65). Atomicity: files write to .tmp and
+rename, manifest last, so a torn save is never mistaken for a complete
+checkpoint (same discipline as Shard::PrepareForAppend,
+src/utils/shard.cc:175-206).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+_SEP = "##"  # key ## flat-device-index [## idx]
+_P = "p|"
+_S = "s|"
+_B = "b|"
+
+
+def _flatten(params, state, buffers) -> dict[str, jnp.ndarray]:
+    flat = {_P + n: a for n, a in params.items()}
+    for n, slots in (state or {}).items():
+        for s, a in slots.items():
+            flat[f"{_S}{n}|{s}"] = a
+    flat.update({_B + n: a for n, a in (buffers or {}).items()})
+    return flat
+
+
+def _spec_to_json(arr) -> list | None:
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    out = []
+    for entry in tuple(sh.spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def _spec_from_json(raw) -> PartitionSpec:
+    if raw is None:
+        return PartitionSpec()
+    return PartitionSpec(
+        *(tuple(e) if isinstance(e, list) else e for e in raw)
+    )
+
+
+def save_sharded(
+    path: str,
+    step: int,
+    params: dict,
+    state: dict | None = None,
+    buffers: dict | None = None,
+    streams: dict[str, int] | None = None,
+) -> str:
+    """Write this process's shards (+ manifest on process 0)."""
+    flat = _flatten(params, state, buffers)
+    proc = jax.process_index()
+    os.makedirs(path, exist_ok=True)
+
+    entries: dict[str, np.ndarray] = {}
+    meta: dict[str, dict] = {}
+    for key, arr in flat.items():
+        meta[key] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "spec": _spec_to_json(arr),
+        }
+        shards = getattr(arr, "addressable_shards", None)
+        if shards is None:  # plain numpy/host value
+            entries[f"{key}{_SEP}0"] = np.asarray(arr)
+            entries[f"{key}{_SEP}0{_SEP}idx"] = _idx_box(
+                tuple(slice(None) for _ in arr.shape), arr.shape
+            )
+            continue
+        for shard in shards:
+            if shard.replica_id != 0:
+                continue  # replicated copies: one writer per shard value
+            didx = _flat_device_index(arr, shard)
+            entries[f"{key}{_SEP}{didx}"] = np.asarray(shard.data)
+            entries[f"{key}{_SEP}{didx}{_SEP}idx"] = _idx_box(
+                shard.index, arr.shape
+            )
+
+    shard_file = os.path.join(path, f"proc_{proc}.npz")
+    with open(shard_file + ".tmp", "wb") as f:
+        np.savez(f, **entries)
+    os.replace(shard_file + ".tmp", shard_file)
+
+    if proc == 0:
+        manifest = {
+            "format": "singa-tpu-sharded-v1",
+            "step": int(step),
+            "streams": dict(streams or {}),
+            "nprocs": jax.process_count(),
+            "arrays": meta,
+        }
+        mpath = os.path.join(path, "manifest.json")
+        with open(mpath + ".tmp", "w") as f:
+            json.dump(manifest, f)
+        os.replace(mpath + ".tmp", mpath)
+    return path
+
+
+def _idx_box(index, shape) -> np.ndarray:
+    """(ndim, 2) [start, stop) per dim from a shard's index tuple."""
+    box = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        box.append([start, stop])
+    if not box:  # scalar
+        box = [[0, 1]]
+    return np.asarray(box, dtype=np.int64)
+
+
+def _flat_device_index(arr, shard) -> int:
+    return int(shard.device.id)
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, "manifest.json")
+    )
+
+
+class ShardedCheckpoint:
+    """Reader: manifest + lazy shard-file access."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, "manifest.json")) as f:
+            self.manifest = json.load(f)
+        if self.manifest.get("format") != "singa-tpu-sharded-v1":
+            raise ValueError(f"{path!r}: not a singa-tpu sharded checkpoint")
+        self.step: int = self.manifest["step"]
+        self.streams: dict[str, int] = self.manifest.get("streams", {})
+        # exactly the manifest's proc files, all present: a torn
+        # multi-process save (a rank died before writing) or stale files
+        # from a differently-sized job must fail loudly here, not
+        # zero-fill params during assemble()
+        nprocs = int(self.manifest.get("nprocs", 1))
+        wanted = [f"proc_{k}.npz" for k in range(nprocs)]
+        missing = [
+            f for f in wanted if not os.path.exists(os.path.join(path, f))
+        ]
+        if missing:
+            raise ValueError(
+                f"{path!r}: incomplete sharded checkpoint — missing "
+                f"{missing} (manifest expects {nprocs} processes)"
+            )
+        self._files = [np.load(os.path.join(path, f)) for f in wanted]
+        # key -> [(file, entry, box)]
+        self._index: dict[str, list] = {}
+        for z in self._files:
+            for entry in z.files:
+                parts = entry.split(_SEP)
+                if parts[-1] == "idx":
+                    continue
+                key = parts[0]
+                self._index.setdefault(key, []).append(
+                    (z, entry, z[f"{entry}{_SEP}idx"])
+                )
+
+    def keys(self) -> list[str]:
+        return sorted(self.manifest["arrays"])
+
+    def assemble(self, key: str) -> np.ndarray:
+        """Host-assembled global array (the slow/fallback path)."""
+        info = self.manifest["arrays"][key]
+        out = np.zeros(tuple(info["shape"]), dtype=np.dtype(info["dtype"]))
+        for z, entry, box in self._index.get(key, []):
+            if out.ndim == 0:
+                out = z[entry].reshape(())
+                continue
+            sl = tuple(slice(int(a), int(b)) for a, b in box[: out.ndim])
+            out[sl] = z[entry]
+        return out
+
+    def place(
+        self, key: str, sharding: NamedSharding, dtype=None
+    ) -> jax.Array:
+        """Device-place ``key`` under ``sharding`` (cast to ``dtype``
+        when given — callers pass the model's dtype so a checkpoint
+        written at a different precision restores in the live one).
+
+        When the target device boxes match the saved ones exactly, each
+        LOCAL shard goes straight to its device and no host ever holds
+        the global array; a box mismatch (mesh/spec changed between save
+        and restore) falls back to assemble + device_put with a warning.
+        Genuine data errors propagate — they must not be mistaken for a
+        mesh change."""
+        info = self.manifest["arrays"][key]
+        shape = tuple(info["shape"])
+        dtype = np.dtype(info["dtype"]) if dtype is None else np.dtype(dtype)
+        by_box: dict[bytes, np.ndarray] = {}
+        for z, entry, box in self._index.get(key, []):
+            by_box[_idx_key(box, len(shape))] = z[entry]
+        # only THIS process's devices: device_put to a non-addressable
+        # remote device is impossible (and unnecessary — each process
+        # restores its own shards)
+        dev_map = sharding.addressable_devices_indices_map(shape)
+        pieces = []
+        for dev, index in dev_map.items():
+            data = by_box.get(_idx_key(_idx_box(index, shape), len(shape)))
+            if data is None:
+                import warnings
+
+                warnings.warn(
+                    f"sharded checkpoint {self.path!r}: {key!r} saved "
+                    "with different shard boxes than the restore "
+                    "sharding (mesh changed?) — host-assembling"
+                )
+                return jax.device_put(
+                    self.assemble(key).astype(dtype, copy=False), sharding
+                )
+            pieces.append(
+                jax.device_put(data.astype(dtype, copy=False), dev)
+            )
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, pieces
+        )
+
+    def close(self) -> None:
+        for z in self._files:
+            z.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _idx_key(box: np.ndarray, ndim: int) -> bytes:
+    return np.asarray(box[:ndim], dtype=np.int64).tobytes()
+
+
+def param_key(name: str) -> str:
+    return _P + name
+
+
+def state_key(name: str, slot: str) -> str:
+    return f"{_S}{name}|{slot}"
+
+
+def buffer_key(name: str) -> str:
+    return _B + name

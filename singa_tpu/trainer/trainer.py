@@ -204,12 +204,18 @@ class Trainer:
     def _materialize_params(self) -> None:
         """Initialize params + updater slots, overlay the resume
         checkpoint (fills Worker::Resume, worker.cc:65-67), and place
-        everything onto the mesh shardings."""
+        everything onto the mesh shardings. Sharded checkpoints
+        (directories) restore shard-to-device without any host gather."""
+        from .sharded_ckpt import is_sharded_checkpoint
+
         params = init_params(self._init_key, self.specs)
         state = self.updater.init_state(params)
         buffers = self.train_net.init_buffers()
         #: stream positions waiting to be applied once pipelines exist
         self._resume_streams: dict[str, int] = {}
+        if self.cfg.checkpoint and is_sharded_checkpoint(self.cfg.checkpoint):
+            self._restore_sharded(params, state, buffers)
+            return
         if self.cfg.checkpoint:
             ck_step, params, state, buffers = restore_into(
                 self.cfg.checkpoint, params, state, buffers
@@ -232,6 +238,57 @@ class Trainer:
         self.buffers = {
             n: jax.device_put(v, self._repl) for n, v in buffers.items()
         }
+
+    def _restore_sharded(self, params, state, buffers) -> None:
+        """Place a sharded checkpoint directly onto the mesh: every
+        saved array goes shard-to-device (no host-global assembly when
+        the mesh matches); entries absent from the checkpoint keep
+        their fresh init."""
+        from .sharded_ckpt import (
+            ShardedCheckpoint,
+            buffer_key,
+            param_key,
+            state_key,
+        )
+
+        with ShardedCheckpoint(self.cfg.checkpoint) as ck:
+            have = set(ck.keys())
+
+            def restore(key, init_val, sharding):
+                if key not in have:
+                    return jax.device_put(init_val, sharding)
+                saved = tuple(ck.manifest["arrays"][key]["shape"])
+                if saved != tuple(init_val.shape):
+                    raise ValueError(
+                        f"checkpoint {self.cfg.checkpoint!r}: {key!r} "
+                        f"shape {saved} != model shape {init_val.shape}"
+                    )
+                # cast to the MODEL's dtype: a checkpoint written at a
+                # different precision must not leak its dtype into the
+                # donating jitted step
+                return ck.place(key, sharding, dtype=init_val.dtype)
+
+            self.params = {
+                n: restore(param_key(n), v, self.param_sh[n])
+                for n, v in params.items()
+            }
+            self.state = {
+                n: {
+                    s: restore(state_key(n, s), v, self.state_sh[n][s])
+                    for s, v in slots.items()
+                }
+                for n, slots in state.items()
+            }
+            self.buffers = {
+                n: restore(buffer_key(n), v, self._repl)
+                for n, v in buffers.items()
+            }
+            self._resume_streams = dict(ck.streams)
+            self.start_step = max(self.start_step, ck.step)
+        self.log(
+            f"resumed sharded from {self.cfg.checkpoint} at step "
+            f"{self.start_step}"
+        )
 
     # ------------------------------------------------------------------
     # device-resident dataset cache
@@ -620,11 +677,20 @@ class Trainer:
         folder = self._checkpoint_dir()
         if folder is None:
             return None
-        path = os.path.join(folder, f"step_{step}.npz")
-        save_checkpoint(
-            path, step, self.params, self.state, self.buffers,
-            streams=self._stream_positions(),
-        )
+        if self.cfg.checkpoint_format == "sharded":
+            from .sharded_ckpt import save_sharded
+
+            path = os.path.join(folder, f"step_{step}.ckpt")
+            save_sharded(
+                path, step, self.params, self.state, self.buffers,
+                streams=self._stream_positions(),
+            )
+        else:
+            path = os.path.join(folder, f"step_{step}.npz")
+            save_checkpoint(
+                path, step, self.params, self.state, self.buffers,
+                streams=self._stream_positions(),
+            )
         self.log(f"step {step}: checkpoint -> {path}")
         return path
 

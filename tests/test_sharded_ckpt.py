@@ -1,0 +1,193 @@
+"""Sharded checkpointing: per-process shard files, arrays stay sharded.
+
+Round-trips on the 8-device virtual mesh with kLayerPartition so params
+are genuinely model-axis-sharded: save must write shard-sized pieces
+(never the gathered global), restore must land arrays back on the mesh
+with their original PartitionSpec, and a resumed run must reproduce the
+uninterrupted trajectory exactly like the npz path does.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.config.schema import ClusterConfig
+from singa_tpu.data.loader import synthetic_arrays, write_records
+from singa_tpu.parallel import build_mesh
+from singa_tpu.trainer import Trainer
+from singa_tpu.trainer.sharded_ckpt import (
+    ShardedCheckpoint,
+    is_sharded_checkpoint,
+    save_sharded,
+)
+from tests.test_trainer import make_conf
+
+
+@pytest.fixture
+def data(tmp_path):
+    return (
+        synthetic_arrays(256, seed=1),
+        synthetic_arrays(128, seed=1, noise_seed=2),
+    )
+
+
+def _trainer(tmp_path, data, sub, steps, mesh, ckfreq=0, ckpt=None):
+    cfg = make_conf(
+        tmp_path / sub, *data, train_steps=steps,
+        checkpoint_frequency=ckfreq,
+    )
+    cfg.neuralnet.partition_type = "kLayerPartition"
+    cfg.checkpoint_format = "sharded"
+    if ckpt:
+        cfg.checkpoint = ckpt
+    cluster = None
+    if ckfreq:
+        cluster = ClusterConfig()
+        cluster.workspace = str(tmp_path / "ws")
+    return Trainer(
+        cfg, cluster, mesh=mesh, seed=3, log=lambda s: None, prefetch=False
+    )
+
+
+def test_roundtrip_preserves_shardings_and_values(tmp_path, data):
+    mesh = build_mesh(2, 4)
+    t = _trainer(tmp_path, data, "a", 4, mesh)
+    t.run_one_batch(0)
+    path = str(tmp_path / "ck.ckpt")
+    save_sharded(path, 1, t.params, t.state, t.buffers, streams={"x": 7})
+    assert is_sharded_checkpoint(path)
+
+    # shard files hold PIECES of sharded params, not gathered arrays
+    sharded_names = [
+        n for n, sh in t.param_sh.items()
+        if any(a is not None for a in tuple(sh.spec))
+    ]
+    assert sharded_names, "test net must actually shard something"
+    with np.load(os.path.join(path, "proc_0.npz")) as z:
+        for name in sharded_names:
+            global_shape = t.params[name].shape
+            entries = [
+                e for e in z.files
+                if e.startswith(f"p|{name}##") and not e.endswith("idx")
+            ]
+            assert len(entries) > 1  # one per device holding a shard
+            for e in entries:
+                assert z[e].size < np.prod(global_shape)
+
+    # restore onto the same mesh: values identical, and every restored
+    # array lands on the trainer's DECLARED placement (post-step arrays
+    # may carry richer GSPMD-propagated output shardings — e.g. a
+    # replicated-by-declaration weight coming back model-sharded from
+    # the step — so param_sh, not the saved array, is the contract)
+    t2 = _trainer(tmp_path, data, "b", 4, mesh, ckpt=path)
+    assert t2.start_step == 1
+    assert t2._resume_streams == {"x": 7}
+    for n in t.params:
+        assert t2.params[n].sharding.spec == t2.param_sh[n].spec
+        np.testing.assert_array_equal(
+            np.asarray(t2.params[n]), np.asarray(t.params[n]), err_msg=n
+        )
+    # the declared-sharded params really are sharded after restore
+    for n in sharded_names:
+        assert any(a is not None for a in tuple(t2.params[n].sharding.spec))
+    for n, slots in t.state.items():
+        for s in slots:
+            np.testing.assert_array_equal(
+                np.asarray(t2.state[n][s]), np.asarray(t.state[n][s])
+            )
+
+
+def test_restore_onto_different_mesh_falls_back(tmp_path, data):
+    t = _trainer(tmp_path, data, "a", 4, build_mesh(2, 4))
+    t.run_one_batch(0)
+    path = str(tmp_path / "ck.ckpt")
+    save_sharded(path, 1, t.params, t.state, t.buffers)
+    # a 8x1 mesh has different device boxes: host-assembly fallback
+    t2 = _trainer(tmp_path, data, "b", 4, build_mesh(8, 1), ckpt=path)
+    for n in t.params:
+        np.testing.assert_array_equal(
+            np.asarray(t2.params[n]), np.asarray(t.params[n]), err_msg=n
+        )
+
+
+def test_sharded_resume_reproduces_uninterrupted_run(tmp_path, data):
+    mesh = build_mesh(2, 4)
+    t_a = _trainer(tmp_path, data, "a", 12, mesh)
+    t_a.run()
+
+    t_b = _trainer(tmp_path, data, "b", 9, mesh, ckfreq=8)
+    t_b.run()
+    ckpt = str(tmp_path / "ws" / "checkpoints" / "step_8.ckpt")
+    assert is_sharded_checkpoint(ckpt)
+    with ShardedCheckpoint(ckpt) as ck:
+        assert ck.step == 8
+        # positions saved for the train stream (8*64 % 256 == 0 here —
+        # the stream wrapped exactly — so check presence, not value)
+        assert any(k.startswith("kTrain|") for k in ck.streams)
+
+    t_c = _trainer(tmp_path, data, "c", 12, mesh, ckpt=ckpt)
+    assert t_c.start_step == 8
+    t_c.run()
+    for name in t_a.params:
+        np.testing.assert_allclose(
+            np.asarray(t_a.params[name]),
+            np.asarray(t_c.params[name]),
+            rtol=2e-5, atol=2e-6,
+            err_msg=f"param {name} diverged after sharded resume",
+        )
+
+
+def test_replica_trainer_resumes_sharded_checkpoint(tmp_path, data):
+    """ReplicaTrainer writes sharded checkpoints through the inherited
+    save(); its resume path must read them back (params + stream
+    positions), not choke on the directory."""
+    from singa_tpu.trainer import ReplicaTrainer
+
+    def mk(sub, steps, ckfreq=0, ckpt=None):
+        cfg = make_conf(
+            tmp_path / sub, *data, train_steps=steps,
+            checkpoint_frequency=ckfreq,
+        )
+        cfg.checkpoint_format = "sharded"
+        cfg.updater.param_type = "Elastic"
+        cfg.updater.moving_rate = 0.3
+        cfg.updater.sync_frequency = 2
+        cfg.updater.warmup_steps = 2
+        if ckpt:
+            cfg.checkpoint = ckpt
+        cluster = None
+        if ckfreq:
+            cluster = ClusterConfig()
+            cluster.workspace = str(tmp_path / "ws")
+        return ReplicaTrainer(
+            cfg, cluster, mesh=build_mesh(4, 1), seed=3,
+            log=lambda s: None, prefetch=False,
+        )
+
+    t_b = mk("b", 6, ckfreq=4)
+    t_b.run()
+    ckpt = str(tmp_path / "ws" / "checkpoints" / "step_4.ckpt")
+    assert is_sharded_checkpoint(ckpt)
+    assert os.path.exists(ckpt + ".server")
+
+    t_c = mk("c", 6, ckpt=ckpt)
+    assert t_c.start_step == 4 and t_c._bootstrapped
+    assert any(k.startswith("kTrain|") for k in t_c._resume_streams)
+    with ShardedCheckpoint(ckpt) as ck:
+        for n in t_c.params:
+            np.testing.assert_array_equal(
+                np.asarray(t_c.params[n]), ck.assemble(f"p|{n}"), err_msg=n
+            )
+
+
+def test_assemble_matches_device_values(tmp_path, data):
+    t = _trainer(tmp_path, data, "a", 2, build_mesh(2, 4))
+    path = str(tmp_path / "ck.ckpt")
+    save_sharded(path, 0, t.params, t.state, t.buffers)
+    with ShardedCheckpoint(path) as ck:
+        for n in t.params:
+            np.testing.assert_array_equal(
+                ck.assemble(f"p|{n}"), np.asarray(t.params[n]), err_msg=n
+            )
