@@ -87,8 +87,14 @@ def _bench_trainer(trainer, n1: int, n2: int, trials: int = 2):
         def run(step0, n):
             s = step0
             while s < step0 + n:
-                take = min(cap, step0 + n - s)
-                trainer.train_chunk(s, take)
+                # _chunk_len keeps cadence semantics (the replica
+                # trainer bounds windows at its sync cadence so protocol
+                # rounds run inside the timed region)
+                take = min(cap, trainer._chunk_len(s), step0 + n - s)
+                if take > 1:
+                    trainer.train_chunk(s, take)
+                else:
+                    trainer.train_one_batch(s)
                 s += take
     else:
         def run(step0, n):
@@ -119,9 +125,13 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
                      unit="samples/sec", tokens_per_sample=None):
     from singa_tpu.utils.flops import device_peak_flops, train_step_flops
 
-    batch = trainer.train_net.batchsize
+    # records per step: the replica trainer consumes one batch per
+    # replica, so use the trainer's own accounting, not net.batchsize
+    batch = trainer._batch_size
     sps = batch / slope
-    flops = train_step_flops(trainer.train_net)
+    flops = train_step_flops(trainer.train_net) * getattr(
+        trainer, "_batches_per_step", 1
+    )
     peak = device_peak_flops()
     mfu = (flops / slope) / peak if peak else None
     value = sps * tokens_per_sample if tokens_per_sample else sps
@@ -245,11 +255,34 @@ def bench_resnet50(n1=6, n2=18, batch=128):
     return _run_workload("resnet50", cfg, n1, n2)
 
 
+def bench_mnist_mlp_replica(n1=256, n2=1280):
+    """The async-protocol engine (ReplicaTrainer, Elastic) on the same
+    flagship MLP: on one chip this runs a single replica with a protocol
+    round every sync_frequency steps — the engine-overhead comparison
+    against the sync trainer's mnist_mlp row."""
+    from __graft_entry__ import _flagship_cfg
+    from singa_tpu.trainer import ReplicaTrainer
+
+    cfg = _prep_cfg(_flagship_cfg(batchsize=1000), 4 * (n1 + n2), bf16=True)
+    cfg.updater.param_type = "Elastic"
+    cfg.updater.moving_rate = 0.9
+    cfg.updater.sync_frequency = 8
+    cfg.updater.warmup_steps = 8
+    trainer = ReplicaTrainer(
+        cfg, seed=0, log=lambda s: None, prefetch=False
+    )
+    for s in range(10):  # warmup + bootstrap before the timed windows
+        trainer.train_one_batch(s)
+    slope, ovh, ts = _bench_trainer(trainer, n1, n2)
+    return _workload_result("mnist_mlp_replica", trainer, slope, ovh, ts)
+
+
 BENCHES = (
     ("mnist_mlp", bench_mnist_mlp),
     ("cifar_alexnet", bench_cifar_alexnet),
     ("tinylm", bench_tinylm),
     ("resnet50", bench_resnet50),
+    ("mnist_mlp_replica", bench_mnist_mlp_replica),
 )
 
 

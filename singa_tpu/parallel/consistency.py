@@ -148,7 +148,15 @@ def sample_sync_indices(
     out: dict[str, np.ndarray] = {}
     for name, shape in shapes.items():
         n = int(np.prod(shape))
-        m = n if ratio >= 1.0 else max(1, int(n * ratio))
+        if ratio >= 1.0:
+            # every coordinate: the sorted sample IS arange — skip the
+            # O(n) reject-sampling draw per replica (measured 5ms/round
+            # on the MLP, pure overhead at full ratio)
+            out[name] = np.broadcast_to(
+                np.arange(n, dtype=np.int32), (nreplicas, n)
+            )
+            continue
+        m = max(1, int(n * ratio))
         rows = [
             np.sort(rng.choice(n, size=m, replace=False))
             for _ in range(nreplicas)

@@ -53,12 +53,21 @@ PROTOCOLS = ("Elastic", "RandomSync")
 
 
 class ReplicaTrainer(Trainer):
-    """Trainer variant holding one param replica per data-axis mesh row."""
+    """Trainer variant holding one param replica per data-axis mesh row.
 
-    # the vmapped step expects a leading replica axis on every batch leaf;
-    # the shared device-cached dataset has none, so stay on the host path
-    _allow_device_cache = False
-    _supports_buffers = False  # replica-axis vmap doesn't thread buffers
+    Production-engine parity with the sync Trainer (round-3 promotion):
+    device-cached datasets (the vmapped step gathers a (replicas, batch)
+    index grid on device), lax.scan chunking with chunk windows bounded
+    by the sync cadence (one dispatch per window, then one sync
+    dispatch), and stateful layers via per-replica buffer state.
+    """
+
+    _allow_device_cache = True
+    _supports_buffers = True
+
+    @property
+    def _batches_per_step(self) -> int:  # one stream batch per replica
+        return self.nreplicas
 
     def __init__(
         self,
@@ -69,7 +78,7 @@ class ReplicaTrainer(Trainer):
         seed: int = 0,
         log: Callable[[str], None] = print,
         prefetch: bool | None = None,
-        device_cache: bool | None = None,  # accepted; replicas stay host-fed
+        device_cache: bool | None = None,
     ):
         ucfg = model_cfg.updater
         if ucfg is None:
@@ -143,6 +152,17 @@ class ReplicaTrainer(Trainer):
             }
             for n, slots in state0.items()
         }
+        # per-replica stateful-layer buffers (each replica tracks its own
+        # running stats, like each worker group's private batch-norm)
+        self._rep_buf_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        buffers0 = self.train_net.init_buffers()
+        self.buffers = {
+            n: jax.device_put(
+                jnp.broadcast_to(v, (self.nreplicas,) + v.shape),
+                self._rep_buf_sh,
+            )
+            for n, v in buffers0.items()
+        }
         # server-side pytrees; materialized at bootstrap
         self.center: dict[str, jnp.ndarray] | None = None
         self.snapshot: dict[str, jnp.ndarray] | None = None
@@ -159,24 +179,28 @@ class ReplicaTrainer(Trainer):
         """vmap the per-replica forward/backward/update over the leading
         replica axis; metrics are averaged across replicas (each group
         reports its own Performance in the reference — one average is the
-        honest aggregate). ``buffers`` passes through untouched — replica
-        nets reject stateful layers (_supports_buffers)."""
+        honest aggregate). Buffers (batch-norm running stats) carry a
+        replica axis too: each replica evolves its own state."""
         rngs = jax.random.split(rng, self.nreplicas)
 
-        def one(p, s, b, r):
+        def one(p, s, b, feed, r):
             def loss_fn(pp):
-                loss, metrics = self.train_net.forward(
-                    pp, b, training=True, rng=r
+                loss, metrics, new_b = self.train_net.forward(
+                    self._cast_compute(pp), self._cast_compute(feed),
+                    training=True, rng=r,
+                    buffers=b, return_buffers=True,
                 )
-                return loss, metrics
+                return loss, (metrics, new_b)
 
-            (_, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            (_, (m, new_b)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(p)
             p2, s2 = self.updater.apply(step, p, grads, s, self.specs)
-            return p2, s2, m
+            return p2, s2, new_b, m
 
-        params, state, metrics = jax.vmap(
-            one, in_axes=(0, 0, 0, 0)
-        )(params, state, batch, rngs)
+        params, state, buffers, metrics = jax.vmap(
+            one, in_axes=(0, 0, 0, 0, 0)
+        )(params, state, buffers, batch, rngs)
         metrics = jax.tree.map(lambda x: jnp.mean(x, axis=0), metrics)
         return params, state, buffers, metrics
 
@@ -204,10 +228,22 @@ class ReplicaTrainer(Trainer):
         """Train batches gain a leading replica axis: each replica consumes
         its own ``batchsize`` records, in stream order — replica i gets the
         i-th of ``nreplicas`` consecutive batches, like each worker group
-        reading its own shard partition (script/load_data.py semantics)."""
+        reading its own shard partition (script/load_data.py semantics).
+
+        With the device-cached dataset only a (replicas, batch) index
+        grid crosses to the device; the gather happens inside the jitted
+        step (Trainer._resolve_batch handles the 2-D index)."""
         if net is not self.train_net:
             return super()._next_batch(net)
         out = {}
+        if self._cached:
+            for name, pipe in self._pipelines[id(net)].items():
+                d = self._dev_data[id(net)][name]
+                idx = np.stack(
+                    [pipe.next_indices() for _ in range(self.nreplicas)]
+                )
+                out[name] = {"__idx__": jnp.asarray(idx), **d}
+            return out
         leaf_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         for name, pipe in self._pipelines[id(net)].items():
             imgs, labels = [], []
@@ -220,6 +256,34 @@ class ReplicaTrainer(Trainer):
                 "label": jax.device_put(np.stack(labels), leaf_sh),
             }
         return out
+
+    def _chunk_batch_indices(self, pos0, i, bs: int, n: int):
+        """Scan-iteration i's (replicas, batch) index grid: replica r
+        takes the (i*nreplicas + r)-th consecutive batch."""
+        k = i * self.nreplicas + jnp.arange(self.nreplicas)[:, None]
+        return (pos0 + k * bs + jnp.arange(bs)[None, :]) % n
+
+    def _chunk_len(self, step: int) -> int:
+        """Warmup steps run singly (their wall-clock feeds SyncConfig and
+        the bootstrap fires between them); afterwards chunks additionally
+        end at the sync cadence so a protocol round follows each window."""
+        if step < self.warmup_steps or not self._bootstrapped:
+            return 1
+        n = super()._chunk_len(step)
+        if self.sync_frequency > 0:
+            # smallest s >= step with (s+1) % freq == 0 (sync_now)
+            fire = step + (-(step + 1)) % self.sync_frequency
+            n = min(n, fire - step + 1)
+        return max(1, int(n))
+
+    def train_chunk(self, step0: int, nsteps: int) -> None:
+        super().train_chunk(step0, nsteps)
+        last = step0 + nsteps - 1
+        if self._bootstrapped and sync_now(
+            last, self.sync_frequency, self.warmup_steps
+        ):
+            with self.timers.phase("sync"):
+                self._sync_round()
 
     def train_one_batch(self, step: int) -> None:
         import time
@@ -304,6 +368,9 @@ class ReplicaTrainer(Trainer):
         replica; group 0 is the one whose params seed the server)."""
         return {n: v[0] for n, v in self.params.items()}
 
+    def _eval_buffers(self):
+        return {n: v[0] for n, v in self.buffers.items()}
+
     def save(self, step: int):
         path = super().save(step)
         if path is not None and self.center is not None:
@@ -329,28 +396,50 @@ class ReplicaTrainer(Trainer):
             # replica state is small (it must fit every replica on one
             # chip), so the host-assemble reader suffices here — the
             # placement still lands on the replica shardings
-            from .sharded_ckpt import ShardedCheckpoint, param_key, state_key
+            from .sharded_ckpt import (
+                ShardedCheckpoint,
+                buffer_key,
+                param_key,
+                state_key,
+            )
 
             with ShardedCheckpoint(path) as ck:
                 have = set(ck.keys())
                 step = ck.step
+
+                def take(key, init_val):
+                    """Assemble with the same loud shape check + model
+                    dtype cast as restore_into / _restore_sharded."""
+                    if key not in have:
+                        return init_val
+                    arr = ck.assemble(key)
+                    if tuple(arr.shape) != tuple(init_val.shape):
+                        raise ValueError(
+                            f"checkpoint {path!r}: {key!r} shape "
+                            f"{arr.shape} != model shape {init_val.shape}"
+                            " (saved with a different replica count?)"
+                        )
+                    return arr.astype(init_val.dtype, copy=False)
+
                 params = {
-                    n: ck.assemble(param_key(n))
-                    if param_key(n) in have else v
+                    n: take(param_key(n), v)
                     for n, v in self.params.items()
                 }
                 state = {
                     n: {
-                        s: ck.assemble(state_key(n, s))
-                        if state_key(n, s) in have else v
+                        s: take(state_key(n, s), v)
                         for s, v in slots.items()
                     }
                     for n, slots in self.state.items()
                 }
+                buffers = {
+                    n: take(buffer_key(n), v)
+                    for n, v in self.buffers.items()
+                }
                 self._resume_streams = dict(ck.streams)
         else:
-            step, params, state, _ = restore_into(
-                path, self.params, self.state
+            step, params, state, buffers = restore_into(
+                path, self.params, self.state, self.buffers
             )
             # stream positions: consumed by the base __init__ when it
             # builds the pipelines, same as the sync trainer's resume path
@@ -368,6 +457,10 @@ class ReplicaTrainer(Trainer):
                 for s, v in slots.items()
             }
             for n, slots in state.items()
+        }
+        self.buffers = {
+            n: jax.device_put(v, self._rep_buf_sh)
+            for n, v in buffers.items()
         }
         server = path + ".server"
         if os.path.exists(server):
@@ -407,14 +500,21 @@ class ReplicaTrainer(Trainer):
     def debug_string(self, step: int) -> str:
         """Replica-0 view of the per-layer dump, plus the replica↔center
         spread (the quantity the protocols are supposed to bound)."""
+        # resolve cached __idx__ feeds to real arrays FIRST (the base
+        # does this inside the jit; here we're outside), then take
+        # replica 0's slice of the (replicas, batch, ...) leaves
+        resolved = self._resolve_batch(
+            self.train_net, self._last_batch, constrain=False
+        )
         batch = {
             name: {k: v[0] for k, v in feed.items()}
-            for name, feed in self._last_batch.items()
+            for name, feed in resolved.items()
         }
         rng = jax.random.fold_in(self._step_key, step)
         p0 = self._eval_params()
         _, _, acts = self.train_net.forward(
-            p0, batch, training=True, rng=rng, return_acts=True
+            p0, batch, training=True, rng=rng,
+            buffers=self._eval_buffers(), return_acts=True,
         )
         lines = [
             "debug: "

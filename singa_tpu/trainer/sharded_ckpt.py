@@ -34,7 +34,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import NamedSharding
 
 _SEP = "##"  # key ## flat-device-index [## idx]
 _P = "p|"
@@ -64,14 +64,6 @@ def _spec_to_json(arr) -> list | None:
         else:
             out.append(entry)
     return out
-
-
-def _spec_from_json(raw) -> PartitionSpec:
-    if raw is None:
-        return PartitionSpec()
-    return PartitionSpec(
-        *(tuple(e) if isinstance(e, list) else e for e in raw)
-    )
 
 
 def save_sharded(

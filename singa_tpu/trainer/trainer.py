@@ -54,11 +54,14 @@ class Trainer:
     """Builds nets, owns params/updater state, runs the cadence loop."""
 
     #: subclasses whose step shape is incompatible with on-device batch
-    #: gathering (e.g. the replica trainer's vmap) switch this off
+    #: gathering switch this off
     _allow_device_cache = True
-    #: subclasses that do not thread buffer state (replica/CD trainers)
+    #: subclasses that do not thread buffer state (the CD trainer)
     #: reject nets with stateful layers instead of silently dropping them
     _supports_buffers = True
+    #: stream batches consumed per train step (the replica trainer feeds
+    #: one batch per replica)
+    _batches_per_step = 1
 
     def __init__(
         self,
@@ -462,6 +465,11 @@ class Trainer:
     def _chunk_cap(self) -> int:
         return int(os.environ.get("SINGA_TPU_CHUNK", "64"))
 
+    def _chunk_batch_indices(self, pos0, i, bs: int, n: int):
+        """Record indices of scan-iteration ``i``'s batch (the replica
+        trainer overrides with a (replicas, batch) grid)."""
+        return (pos0 + i * bs + jnp.arange(bs)) % n
+
     def _make_chunk_fn(self, nsteps: int) -> Callable:
         pipes = self._pipelines[id(self.train_net)]
         meta = {
@@ -480,7 +488,7 @@ class Trainer:
                 batch = {}
                 for name, d in data.items():
                     bs, n = meta[name]
-                    idx = (pos0s[name] + i * bs + jnp.arange(bs)) % n
+                    idx = self._chunk_batch_indices(pos0s[name], i, bs, n)
                     batch[name] = {"__idx__": idx, **d}
                 batch = self._resolve_batch(self.train_net, batch)
                 rng = jax.random.fold_in(self._step_key, step)
@@ -522,7 +530,7 @@ class Trainer:
                 )
             )
         for name, pipe in pipes.items():
-            pipe.advance(nsteps)
+            pipe.advance(nsteps * self._batches_per_step)
         # metrics arrive pre-summed over the chunk; Performance pulls to
         # host only at display time
         self.perf.update_summed(summed, nsteps)
@@ -566,15 +574,21 @@ class Trainer:
         evaluate a single replica's view."""
         return self.params
 
+    def _eval_buffers(self):
+        """Buffers used by eval steps (replica trainers evaluate replica
+        0's running stats)."""
+        return self.buffers
+
     def evaluate(self, net: Net, nsteps: int, phase: str, step: int) -> dict:
         """Test/Validate (worker.cc:318-348): nsteps batches, averaged."""
         fn = self._eval_step_for(net)
         perf = Performance()
         eval_params = self._eval_params()
+        eval_buffers = self._eval_buffers()
         with self.timers.phase("eval"):
             for _ in range(nsteps):
                 perf.update(
-                    fn(eval_params, self.buffers, self._next_batch(net))
+                    fn(eval_params, eval_buffers, self._next_batch(net))
                 )
         avg = perf.avg()
         self.log(f"step {step}: {phase} {perf.to_string()}")
