@@ -271,8 +271,9 @@ def bench_mnist_mlp_replica(n1=256, n2=1280):
     trainer = ReplicaTrainer(
         cfg, seed=0, log=lambda s: None, prefetch=False
     )
-    for s in range(10):  # warmup + bootstrap before the timed windows
-        trainer.train_one_batch(s)
+    # _bench_trainer's untimed warm pass single-steps the warmup (the
+    # replica _chunk_len returns 1 pre-bootstrap) and bootstraps before
+    # the timed windows — no extra priming needed
     slope, ovh, ts = _bench_trainer(trainer, n1, n2)
     return _workload_result("mnist_mlp_replica", trainer, slope, ovh, ts)
 

@@ -9,10 +9,13 @@ this module implements the LMDB 0.9 on-disk format directly:
   ``(key, value)`` pairs in key order, following big-value overflow chains.
   This is the moral equivalent of ``mdb_cursor_get(MDB_NEXT)`` in the
   reference's cursor wraparound loop (layer.cc:276-303).
-* ``write_lmdb`` — a minimal single-transaction writer producing a valid
-  database (leaf + branch + overflow pages, twin meta pages) that both this
-  reader and real liblmdb can open. Used by tests and by the loader CLI to
-  interoperate with Caffe tooling.
+* ``write_lmdb`` — a minimal single-transaction writer producing a
+  database (leaf + branch + overflow pages, twin meta pages) laid out per
+  the LMDB 0.9 format notes below. Verified round-trippable by this
+  reader AND by the independent native C++ walker
+  (singa_tpu/native/lmdbcodec.cc); compatibility with real liblmdb is by
+  construction from the format, NOT verified — no liblmdb exists in this
+  image to test against (checked: no system library, no python binding).
 
 Format notes (LMDB 0.9, 64-bit little-endian layout — the only layout the
 reference ever ran against):

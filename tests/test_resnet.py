@@ -140,18 +140,9 @@ def test_bn_buffers_checkpoint_roundtrip(shard, tmp_path):
     )
 
 
-def test_replica_trainer_rejects_buffers(shard):
-    from singa_tpu.config import parse_cluster_config
-    from singa_tpu.config.schema import ConfigError
-    from singa_tpu.trainer import make_trainer
-
-    cfg = _bn_net(shard)
-    cfg.updater.param_type = "Elastic"
-    cluster = parse_cluster_config(
-        'nworkers: 2 nservers: 1 workspace: "/tmp/x"'
-    )
-    with pytest.raises(ConfigError, match="buffers"):
-        make_trainer(cfg, cluster, log=lambda s: None)
+# (the former rejects-buffers test is gone: ReplicaTrainer supports
+# stateful layers since the round-3 promotion — positively covered by
+# test_consistency.py::TestReplicaProductionEngine)
 
 
 # ---------------------------- resnet generator ----------------------------
