@@ -33,6 +33,17 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
+    # honor an explicit JAX_PLATFORMS even on images whose sitecustomize
+    # pre-pins an accelerator plugin (the env var alone is overridden
+    # there) — e.g. JAX_PLATFORMS=cpu for local multi-process fleets
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
     from .parallel import init_distributed
 
     args = parse_args(argv)

@@ -198,3 +198,80 @@ neuralnet {{
     assert [r["nworkers"] for r in results] == [1, 2]
     assert results[0]["efficiency"] == 1.0
     assert all(r["samples_per_sec"] > 0 for r in results)
+
+
+# ---------------------------------------------------------------------
+# cluster launch/admin tool (run.sh / node.sh analog)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cluster_tool_start_ps_stop_local(tmp_path, monkeypatch):
+    """`cluster start` launches one CLI process per hostfile line
+    (localhost -> subprocess), `ps` reads the pid files, the job trains
+    to completion, and `stop` clears the records — the run.sh lifecycle
+    executed for real, locally."""
+    import socket
+    import time
+
+    from singa_tpu.data.loader import synthetic_arrays, write_records
+    from singa_tpu.tools import cluster
+
+    shard = str(tmp_path / "shard")
+    write_records(shard, *synthetic_arrays(64, seed=7))
+    conf = tmp_path / "job.conf"
+    conf.write_text(f"""
+name: "cluster-tool-test"
+train_steps: 4
+updater {{ base_learning_rate: 0.1 param_type: "Param" }}
+neuralnet {{
+  layer {{ name: "data" type: "kShardData"
+          data_param {{ path: "{shard}" batchsize: 16 }} }}
+  layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+          mnist_param {{ norm_a: 255 norm_b: 0 }} }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{ name: "fc" type: "kInnerProduct" srclayers: "mnist"
+          inner_product_param {{ num_output: 10 }}
+          param {{ name: "w" init_method: "kUniformSqrtFanIn" }}
+          param {{ name: "b" init_method: "kConstant" value: 0 }} }}
+  layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "fc" srclayers: "label"
+          softmaxloss_param {{ topk: 1 }} }}
+}}
+""")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(f"127.0.0.1:{port}\n127.0.0.1\n")
+    ws = tmp_path / "ws"
+    monkeypatch.chdir(tmp_path)
+    # children must stay on CPU (test processes may not grab the TPU)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+
+    rc = cluster.main([
+        "start", "-n", "2", "-hostfile", str(hostfile),
+        "-model_conf", str(conf), "-workspace", str(ws),
+    ])
+    try:
+        assert rc == 0
+        pids = cluster._pids(str(ws))
+        assert sorted(pids) == [0, 1]
+        # wait for both ranks to finish training (short job)
+        deadline = time.time() + 240
+        while time.time() < deadline and any(
+            cluster._alive(pid) for _, pid in pids.values()
+        ):
+            time.sleep(1)
+        for rank in (0, 1):
+            log = (ws / "procs" / f"rank{rank}.log").read_text()
+            assert "training 'cluster-tool-test'" in log, log
+            assert "mesh {'data': 2" in log, log
+        assert cluster.main(["ps", "-hostfile", str(hostfile),
+                             "-workspace", str(ws)]) == 0
+    finally:
+        # a hung rendezvous must not leave CPU-bound children behind on
+        # this 1-core host (they'd trip later tests' collective timeouts)
+        cluster.main(["stop", "-hostfile", str(hostfile),
+                      "-workspace", str(ws)])
+    assert cluster._pids(str(ws)) == {}
