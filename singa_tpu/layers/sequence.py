@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config.schema import ConfigError
-from ..ops.attention import attention, flash_attention
+from ..ops.attention import attention
 from .base import Layer, Shape, require_one_src
 from .data import _ArrayDataLayer
 
@@ -179,7 +179,13 @@ class AttentionLayer(Layer):
 
             o = ring_attention(q, k, v, self._seq_mesh(), causal=True)
         elif self.mode in ("flash", "ring"):
-            o = flash_attention(q, k, v, True)
+            # dense-vs-kernel by per-device score footprint (see
+            # ops.attention.auto_attention — dense measured faster
+            # whenever the scores fit; the kernel is for long context)
+            from ..ops.attention import auto_attention
+
+            n_dev = self.mesh.size if self.mesh is not None else 1
+            o = auto_attention(q, k, v, causal=True, n_devices=n_dev)
         else:
             o = attention(q, k, v, causal=True)
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, d)

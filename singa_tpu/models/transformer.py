@@ -28,7 +28,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import attention, flash_attention
+from ..ops.attention import attention
 from ..parallel.ring import ring_attention
 
 
@@ -104,7 +104,15 @@ def _attend(q, k, v, cfg: TransformerConfig, mesh):
             raise ValueError("attn='ring' requires a mesh with a seq axis")
         return ring_attention(q, k, v, mesh, causal=True)
     if cfg.attn == "flash":
-        return flash_attention(q, k, v, True)
+        # dense below the per-device score-footprint threshold, kernel
+        # above — "flash" means "don't blow memory", not "always
+        # kernel" (ops.attention.auto_attention, BASELINE.md r3)
+        from ..ops.attention import auto_attention
+
+        return auto_attention(
+            q, k, v, causal=True,
+            n_devices=mesh.size if mesh is not None else 1,
+        )
     return attention(q, k, v, causal=True)
 
 

@@ -370,3 +370,23 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def auto_attention(q, k, v, *, causal=False, n_devices=1):
+    """Pick dense vs the Pallas kernel by score-tensor footprint.
+
+    Measured on TPU v5e (BASELINE.md r3): XLA's fused dense attention
+    beats the kernel at every size where the S x S score tensor
+    comfortably fits HBM, so the kernel's job is the long-context
+    regime where dense would blow memory. The footprint estimate is
+    per device (fwd+bwd fp32 scores / ``n_devices`` — pass the mesh
+    size when batch/seq dims are sharded); the threshold is
+    SINGA_TPU_DENSE_ATTN_MB (default 512).
+    """
+    import os
+
+    b, h, s, _ = q.shape
+    scores_mb = b * h * s * s * 4 * 2 / 1e6 / max(1, n_devices)
+    if scores_mb <= float(os.environ.get("SINGA_TPU_DENSE_ATTN_MB", "512")):
+        return attention(q, k, v, causal=causal)
+    return flash_attention(q, k, v, causal)

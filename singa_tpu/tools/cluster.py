@@ -225,7 +225,9 @@ def ps(args) -> int:
 
 
 def fleet_exec(args) -> int:
-    """node.sh's generic verb: run a command on every host."""
+    """node.sh's generic verb: run a command on every host. Nonzero when
+    any host failed, so &&-chained launch scripts fail fast."""
+    rc = 0
     for host in read_hostfile(args.hostfile):
         if _is_local(host):
             r = subprocess.run(
@@ -233,8 +235,9 @@ def fleet_exec(args) -> int:
             )
         else:
             r = _ssh(host, args.arg)
+        rc = rc or r.returncode
         print(f"--- {host} (rc={r.returncode})\n{r.stdout}{r.stderr}", end="")
-    return 0
+    return rc
 
 
 def fleet_ls(args) -> int:
@@ -257,18 +260,23 @@ def fleet_ssh(args) -> int:
 
 
 def fleet_scp(args) -> int:
-    """Push a path to every remote host (node.sh `scp` verb)."""
+    """Push a path to every remote host at the SAME absolute path
+    (node.sh `scp` verb) — a relative destination would resolve against
+    the remote home while `start` cd's into this cwd."""
+    path = os.path.abspath(args.arg)
+    rc = 0
     for host in read_hostfile(args.hostfile):
         if _is_local(host):
             print(f"{host}: local, skipping")
             continue
         r = subprocess.run(
-            ["scp", *SSH_OPTS, "-r", args.arg,
-             f"{host.split(':', 1)[0]}:{args.arg}"],
+            ["scp", *SSH_OPTS, "-r", path,
+             f"{host.split(':', 1)[0]}:{path}"],
             capture_output=True, text=True,
         )
+        rc = rc or r.returncode
         print(f"{host}: rc={r.returncode} {r.stderr}".rstrip())
-    return 0
+    return rc
 
 
 VERBS = {
