@@ -241,3 +241,52 @@ class TestTransformerLM:
         mesh = build_sp_mesh(2, 4)
         loss0, loss1 = self._train(cfg, tokens, mesh=mesh, steps=60)
         assert loss1 < 0.3 * loss0, (loss0, loss1)
+
+
+class TestAutoAttention:
+    """auto_attention picks dense below the per-device score-footprint
+    threshold and the kernel above it (BASELINE.md r3 measurement)."""
+
+    def _spy(self, monkeypatch):
+        from singa_tpu.ops import attention as A
+
+        calls = []
+        real_dense, real_flash = A.attention, A.flash_attention
+        monkeypatch.setattr(
+            A, "attention",
+            lambda *a, **k: calls.append("dense") or real_dense(*a, **k),
+        )
+        monkeypatch.setattr(
+            A, "flash_attention",
+            lambda *a, **k: calls.append("flash") or real_flash(*a, **k),
+        )
+        return calls
+
+    def test_small_goes_dense_large_goes_kernel(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from singa_tpu.ops.attention import auto_attention
+
+        calls = self._spy(monkeypatch)
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 64, 16))
+        auto_attention(q, q, q, causal=True)
+        assert calls == ["dense"]  # 2*2*64*64*8B = 0.13 MB << 512
+
+        calls.clear()
+        monkeypatch.setenv("SINGA_TPU_DENSE_ATTN_MB", "0.05")
+        out = auto_attention(q, q, q, causal=True)
+        assert calls[0] == "flash"
+        assert jnp.isfinite(out).all()
+
+    def test_n_devices_scales_the_footprint(self, monkeypatch):
+        import jax
+
+        from singa_tpu.ops.attention import auto_attention
+
+        calls = self._spy(monkeypatch)
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 64, 16))
+        monkeypatch.setenv("SINGA_TPU_DENSE_ATTN_MB", "0.05")
+        # sharded over enough devices, the per-device scores fit again
+        auto_attention(q, q, q, causal=True, n_devices=8)
+        assert calls == ["dense"]
