@@ -215,7 +215,8 @@ def bench_cifar_alexnet(n1=256, n2=1280, batch=256):
     return _run_workload("cifar_alexnet", cfg, n1, n2)
 
 
-def bench_tinylm(n1=256, n2=1280, seq_len=128):
+def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
+                 name="tinylm"):
     from singa_tpu.config import load_model_config
     from singa_tpu.data.loader import synthetic_token_arrays, write_records
 
@@ -223,14 +224,16 @@ def bench_tinylm(n1=256, n2=1280, seq_len=128):
     tmp = _tmpdir()
     shard = os.path.join(tmp, "shard")
     write_records(
-        shard, *synthetic_token_arrays(256, seq_len=seq_len, vocab=256)
+        shard, *synthetic_token_arrays(n_samples, seq_len=seq_len, vocab=256)
     )
     for layer in cfg.neuralnet.layer:
         if layer.type == "kSequenceData":
             layer.data_param.path = shard
+            if batch:
+                layer.data_param.batchsize = batch
     _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
     return _run_workload(
-        "tinylm", cfg, n1, n2, unit="tokens/sec", tokens_per_sample=seq_len
+        name, cfg, n1, n2, unit="tokens/sec", tokens_per_sample=seq_len
     )
 
 
@@ -253,6 +256,15 @@ def bench_resnet50(n1=6, n2=18, batch=128):
             layer.data_param.random_skip = 0
     _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
     return _run_workload("resnet50", cfg, n1, n2)
+
+
+def bench_lm_longctx(n1=64, n2=256):
+    """tinylm at S=8192 (batch 1): the long-context regime where the
+    S x S score tensor exceeds the dense budget and the auto-blocked
+    Pallas flash kernel carries the attention (BASELINE.md r3)."""
+    return bench_tinylm(
+        n1, n2, seq_len=8192, batch=1, n_samples=32, name="lm_longctx"
+    )
 
 
 def bench_mnist_mlp_replica(n1=256, n2=1280):
@@ -282,6 +294,7 @@ BENCHES = (
     ("mnist_mlp", bench_mnist_mlp),
     ("cifar_alexnet", bench_cifar_alexnet),
     ("tinylm", bench_tinylm),
+    ("lm_longctx", bench_lm_longctx),
     ("resnet50", bench_resnet50),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),
 )
