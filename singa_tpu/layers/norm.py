@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 
 from ..config.schema import ConfigError
@@ -55,29 +56,40 @@ class BatchNormLayer(Layer):
         return src
 
     def apply_stateful(self, params, buffers, inputs, *, training, rng=None):
+        from .. import ops
+
         x = inputs[0]
-        axes = (0,) if x.ndim == 2 else (0, 2, 3)
-        shape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
         if training:
-            # stats in fp32 even under bf16 compute
-            xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            # fused one-pass BN (ops/norm.py custom VJP — stats in fp32,
+            # minimal HBM traffic; 18ms -> see BASELINE.md r4 ablation)
+            y, mean, var = ops.batch_norm_train(
+                x,
+                params[self.gname],
+                params[self.bname],
+                self.eps,
+                # running mean anchors the one-pass moments: a free
+                # independent input (an anchor computed from x costs
+                # ~2.5ms/step on ResNet-50 — ops/norm.py docstring)
+                shift=jax.lax.stop_gradient(buffers[self.mean_buf]),
+            )
+            # running stats are a detached side effect
+            mean = jax.lax.stop_gradient(mean)
+            var = jax.lax.stop_gradient(var)
             m = self.momentum
             updates = {
                 self.mean_buf: m * buffers[self.mean_buf] + (1 - m) * mean,
                 self.var_buf: m * buffers[self.var_buf] + (1 - m) * var,
             }
-        else:
-            mean = buffers[self.mean_buf]
-            var = buffers[self.var_buf]
-            updates = {}
-        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
-        scale = (params[self.gname] * inv).astype(x.dtype).reshape(shape)
-        shift = (
-            params[self.bname] - params[self.gname] * mean * inv
-        ).astype(x.dtype).reshape(shape)
-        return x * scale + shift, updates
+            return y, updates
+        y = ops.batch_norm_infer(
+            x,
+            params[self.gname],
+            params[self.bname],
+            buffers[self.mean_buf],
+            buffers[self.var_buf],
+            self.eps,
+        )
+        return y, {}
 
     def apply(self, params, inputs, *, training, rng=None):
         raise RuntimeError(

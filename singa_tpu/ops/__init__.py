@@ -26,6 +26,7 @@ from .nn import (
     pooled_size,
     softmax_loss,
 )
+from .norm import batch_norm_infer, batch_norm_train
 
 __all__ = [
     "bnll",
@@ -42,4 +43,6 @@ __all__ = [
     "max_pool2d",
     "pooled_size",
     "softmax_loss",
+    "batch_norm_infer",
+    "batch_norm_train",
 ]

@@ -1,0 +1,176 @@
+"""Fused batch normalization with a hand-written VJP.
+
+Why this exists (round-4 perf work): autodiff through
+``jnp.mean``/``jnp.var`` plus fp32 casts generated 4-6 extra
+full-activation passes per BatchNorm; on ResNet-50 at batch 128 the 53
+BN layers owned 18 ms of a 50.9 ms train step (measured by layer
+ablation on a v5e chip, BASELINE.md r4). This implementation does the
+information-theoretic minimum of HBM traffic:
+
+  fwd:  one fused read of x for both moments (sum and sum-of-squares
+        accumulated in fp32 inside the reduction — no materialized fp32
+        copy), then one read+write for the normalize.
+  bwd:  one fused read of (dy, x) for the two reductions
+        (sum(dy), sum(dy*xhat)), one read of (dy, x) + write for dx.
+
+Total: 8 activation-sized bf16 touches for fwd+bwd, vs ~14 (some fp32)
+from autodiff of the naive formula.
+
+The reference has no batch normalization (its registry tops out at LRN,
+/root/reference/src/worker/neuralnet.cc:13-33); this op backs the
+kBatchNorm extension layer (singa_tpu/layers/norm.py) that the ResNet
+configs (BASELINE stretch config 5) are built from.
+
+``batch_norm_train`` returns (y, mean, var). The y-cotangent math is
+the standard BN backward:
+
+  dgamma = sum(dy * xhat),  dbeta = sum(dy)
+  dx     = gamma*inv * (dy - dbeta/n - xhat * dgamma/n)
+
+and the mean/var cotangents contribute dmean/n + 2*dvar*(x-mean)/n,
+folded into the same dx pass (free when they are the usual structural
+zeros — XLA constant-folds them away).
+
+Numerics: one-pass moments E[x^2]-E[x]^2 cancel catastrophically when
+|mean|/std exceeds ~3e3 in fp32 (ulp 6e-8 of mean^2 swamps std^2).
+Two defenses, both costless on the hot path:
+
+  1. an optional per-channel ``shift`` anchor subtracted inside the
+     pass (layers/norm.py passes its running-mean buffer — a free
+     independent input, unlike anchors computed from x, which measured
+     +2.5ms/step on ResNet-50 by serializing ahead of every stats
+     reduction);
+  2. a lax.cond rescue: when any channel's one-pass variance is within
+     10x of the cancellation noise floor (var < 1e-5 * mean_shifted^2,
+     i.e. |mean|/std > ~316 in the anchored frame), a second,
+     cancellation-free pass E[(x - s - m)^2] recomputes the exact
+     variance. The predicate is false in any sane training regime, so
+     the branch never runs — but step 0 with a cold anchor and a
+     pathologically offset input is still *correct*, just one pass
+     slower. (Under vmap, cond lowers to select and both branches pay —
+     don't vmap this op; the trainer never does.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _axes_shape(x: jnp.ndarray):
+    """Reduction axes and broadcast shape for (N, C) or (N, C, H, W)."""
+    if x.ndim == 2:
+        return (0,), (1, -1)
+    return (0, 2, 3), (1, -1, 1, 1)
+
+
+def _moments(x: jnp.ndarray, axes, shape, n: int, shift):
+    """Single-pass fp32 batch moments of the shifted data: with
+    s = shift (a per-channel mean estimate), E[x] = E[x-s] + s and
+    Var[x] = E[(x-s)^2] - E[x-s]^2. The elementwise cast, subtract, and
+    square all fuse into the two reductions, so x is read once from HBM
+    and no fp32 copy is materialized. See the module docstring for the
+    cancellation rescue."""
+    sf = None if shift is None else shift.astype(jnp.float32).reshape(shape)
+
+    def shifted(xx):
+        xxf = xx.astype(jnp.float32)
+        return xxf if sf is None else xxf - sf
+
+    xf = shifted(x)
+    s1 = jnp.sum(xf, axes)
+    s2 = jnp.sum(xf * xf, axes)
+    m = s1 / n
+    var = jnp.maximum(s2 / n - m * m, 0.0)
+
+    def exact_var():
+        # cancellation-free second pass around the now-known exact mean.
+        # Recompute the shifted cast from x INSIDE the branch: closing
+        # over xf would force XLA to materialize the fp32 copy in HBM
+        # for the branch operand (measured +4ms/step on ResNet-50 even
+        # with the branch never taken)
+        d = shifted(x) - m.reshape(shape)
+        return jnp.sum(d * d, axes) / n
+
+    suspect = jnp.any(var * 1e5 < m * m)
+    var = jax.lax.cond(suspect, exact_var, lambda: var)
+    mean = m if shift is None else m + shift.astype(jnp.float32)
+    return mean, var
+
+
+def _apply(x, gamma, beta, eps, shift):
+    axes, shape = _axes_shape(x)
+    n = x.size // x.shape[1]
+    mean, var = _moments(x, axes, shape, n, shift)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - scale * mean
+    y = (
+        x * scale.astype(x.dtype).reshape(shape)
+        + shift.astype(x.dtype).reshape(shape)
+    )
+    return y, mean, var, inv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def batch_norm_train(x, gamma, beta, eps=1e-5, shift=None):
+    """-> (y, mean, var). Batch stats are fp32; y stays in x.dtype.
+
+    ``shift`` (optional, (C,)) is a numerical-stability anchor for the
+    one-pass moments — pass a running-mean estimate; it does not change
+    the math and receives a zero gradient."""
+    y, mean, var, _ = _apply(x, gamma, beta, eps, shift)
+    return y, mean, var
+
+
+def _bn_fwd(x, gamma, beta, eps, shift):
+    y, mean, var, inv = _apply(x, gamma, beta, eps, shift)
+    return (y, mean, var), (x, gamma, beta, mean, inv, shift)
+
+
+def _bn_bwd(eps, res, cts):
+    dy, dmean, dvar = cts
+    x, gamma, beta, mean, inv, shift = res
+    axes, shape = _axes_shape(x)
+    n = x.size // x.shape[1]
+    dyf = dy.astype(jnp.float32)
+    xc = x.astype(jnp.float32) - mean.reshape(shape)
+    xhat = xc * inv.reshape(shape)
+    dbeta = jnp.sum(dyf, axes)
+    dgamma = jnp.sum(dyf * xhat, axes)
+    k = (gamma.astype(jnp.float32) * inv).reshape(shape)
+    dxf = k * (
+        dyf - (dbeta / n).reshape(shape) - xhat * (dgamma / n).reshape(shape)
+    )
+    # mean/var output cotangents: usually structural zeros (running-stat
+    # updates are detached); the terms fuse into the same dx pass and
+    # XLA folds them away when zero, so generality costs nothing
+    dxf = dxf + (dmean / n).reshape(shape) + xc * (2.0 / n * dvar).reshape(shape)
+    dx = dxf.astype(x.dtype)
+    # shift is a stability anchor that cancels out of the math — zero
+    # gradient (None when the arg was None, matching its pytree)
+    dshift = None if shift is None else jnp.zeros_like(shift)
+    return (
+        dx,
+        dgamma.astype(gamma.dtype),
+        dbeta.astype(beta.dtype),
+        dshift,
+    )
+
+
+batch_norm_train.defvjp(_bn_fwd, _bn_bwd)
+
+
+def batch_norm_infer(x, gamma, beta, mean, var, eps=1e-5):
+    """Normalize by running stats (eval path); plain autodiff is fine
+    here — stats are constants, so it's one fused elementwise pass."""
+    _, shape = _axes_shape(x)
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - scale * mean.astype(jnp.float32)
+    return (
+        x * scale.astype(x.dtype).reshape(shape)
+        + shift.astype(x.dtype).reshape(shape)
+    )

@@ -53,6 +53,107 @@ def shard(tmp_path):
     return path
 
 
+@pytest.mark.parametrize("shape", [(8, 16), (4, 8, 5, 5)])
+def test_fused_bn_matches_naive_formula(shape):
+    """ops.batch_norm_train (custom VJP, one-pass moments) must agree
+    with the textbook two-pass formula in values AND grads."""
+    from singa_tpu import ops
+
+    key = jax.random.PRNGKey(0)
+    kx, kg, kb, kd = jax.random.split(key, 4)
+    c = shape[1]
+    x = jax.random.normal(kx, shape, jnp.float32) * 3.0 + 1.0
+    gamma = jax.random.normal(kg, (c,)) * 0.5 + 1.0
+    beta = jax.random.normal(kb, (c,))
+    dy = jax.random.normal(kd, shape)
+    eps = 1e-5
+    axes = (0,) if len(shape) == 2 else (0, 2, 3)
+    bshape = (1, -1) if len(shape) == 2 else (1, -1, 1, 1)
+
+    def naive(x, gamma, beta):
+        mean = jnp.mean(x, axes)
+        var = jnp.var(x, axes)
+        inv = 1.0 / jnp.sqrt(var + eps)
+        y = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+        return y * gamma.reshape(bshape) + beta.reshape(bshape), mean, var
+
+    y_f, m_f, v_f = ops.batch_norm_train(x, gamma, beta, eps)
+    y_n, m_n, v_n = naive(x, gamma, beta)
+    np.testing.assert_allclose(y_f, y_n, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(m_f, m_n, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(v_f, v_n, rtol=1e-4, atol=1e-4)
+
+    def loss_fused(x, gamma, beta):
+        y, m, v = ops.batch_norm_train(x, gamma, beta, eps)
+        # stats detached, like the layer's running-stat update
+        return jnp.sum(y * dy) + 0.0 * jnp.sum(
+            jax.lax.stop_gradient(m) + jax.lax.stop_gradient(v)
+        )
+
+    def loss_naive(x, gamma, beta):
+        y, _, _ = naive(x, gamma, beta)
+        return jnp.sum(y * dy)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(gf, gn):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("shape", [(64, 4), (16, 4, 6, 6)])
+def test_fused_bn_one_pass_variance_is_anchored(shape):
+    """A channel with |mean|/std ~ 1e5 cancels catastrophically in a raw
+    one-pass E[x^2]-E[x]^2 (fp32 holds ~7 digits). Unanchored, the
+    lax.cond rescue pass must recover the exact variance (the step-0 /
+    cold-anchor path); with an explicit shift anchor the one-pass result
+    is already exact."""
+    from singa_tpu import ops
+
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, shape, jnp.float32) * 1e-2 + 1e3
+    c = shape[1]
+    gamma = jnp.ones((c,))
+    beta = jnp.zeros((c,))
+    axes = (0,) if len(shape) == 2 else (0, 2, 3)
+    true_var = jnp.var(x, axis=axes)
+
+    _, _, var_default = ops.batch_norm_train(x, gamma, beta, 1e-5)
+    np.testing.assert_allclose(var_default, true_var, rtol=1e-2)
+
+    # explicit anchor path
+    _, _, var_explicit = ops.batch_norm_train(
+        x, gamma, beta, 1e-5, shift=jnp.full((c,), 1e3)
+    )
+    np.testing.assert_allclose(var_explicit, true_var, rtol=1e-2)
+
+
+def test_fused_bn_mean_var_cotangents():
+    """Differentiating through the mean/var outputs (no stop_gradient)
+    must match autodiff of the naive formula — the VJP's dmean/dvar
+    terms are real, not dropped."""
+    from singa_tpu import ops
+
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (32, 3), jnp.float32)
+    gamma = jnp.ones((3,))
+    beta = jnp.zeros((3,))
+
+    def loss_fused(x):
+        y, m, v = ops.batch_norm_train(x, gamma, beta, 1e-5)
+        return jnp.sum(y**2) + jnp.sum(m * 3.0) + jnp.sum(v * 0.5)
+
+    def loss_naive(x):
+        m = jnp.mean(x, 0)
+        v = jnp.var(x, 0)
+        y = (x - m) / jnp.sqrt(v + 1e-5)
+        return jnp.sum(y**2) + jnp.sum(m * 3.0) + jnp.sum(v * 0.5)
+
+    np.testing.assert_allclose(
+        jax.grad(loss_fused)(x), jax.grad(loss_naive)(x),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
 def test_bn_normalizes_batch(shard):
     """Training-mode BN output has ~zero mean / unit variance per feature."""
     net = build_net(_bn_net(shard), "kTrain")
