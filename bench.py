@@ -237,7 +237,10 @@ def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
     )
 
 
-def bench_resnet50(n1=6, n2=18, batch=128):
+def bench_resnet50(n1=20, n2=60, batch=128):
+    # window sizes: at ~46ms/step, 6/18-step windows left the slope
+    # exposed to ±2ms of tunnel jitter; 20/60 brings repeatability to
+    # ~±0.2ms (r4 A/B measurements)
     from singa_tpu.config import load_model_config
     from singa_tpu.data.loader import synthetic_arrays, write_records
 

@@ -99,6 +99,53 @@ def test_conv2d_matches_im2col_gemm():
                                atol=1e-4)
 
 
+def test_conv2d_space_to_depth_rewrite_is_exact():
+    """The strided small-channel rewrite (ops/nn.py _conv2d_space_to_depth,
+    the ResNet conv1 7x7/2 path) must agree with the direct lowering —
+    same math, MXU-shaped. Covers k % s != 0 (7/2) and k % s == 0 (6/3),
+    plus grads through the rewrite."""
+    from singa_tpu.ops import nn as opsnn
+
+    rng = np.random.RandomState(2)
+    for (c, h, k, s, p) in [(3, 16, 7, 2, 3), (3, 18, 6, 3, 0),
+                            (4, 20, 5, 2, 2)]:
+        assert (h + 2 * p) % s == 0, "case must exercise the rewrite"
+        x = rng.randn(2, c, h, h).astype(np.float32)
+        w = rng.randn(8, c, k, k).astype(np.float32)
+        assert opsnn._s2d_profitable(jnp.array(x), jnp.array(w), s, p), (
+            f"gate must take the rewrite for {(c, h, k, s, p)}"
+        )
+        got = opsnn._conv2d_space_to_depth(
+            jnp.array(x), jnp.array(w), s, p, jax.lax.Precision.HIGHEST
+        )
+        direct = jax.lax.conv_general_dilated(
+            jnp.array(x), jnp.array(w), (s, s), [(p, p), (p, p)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-5)
+
+    # gradients flow through the rewrite identically
+    x = jnp.array(rng.randn(2, 3, 16, 16).astype(np.float32))
+    w = jnp.array(rng.randn(8, 3, 7, 7).astype(np.float32))
+
+    def f_rewrite(x, w):
+        return jnp.sum(ops.conv2d(x, w, stride=2, pad=3) ** 2)
+
+    def f_direct(x, w):
+        y = jax.lax.conv_general_dilated(
+            x, w, (2, 2), [(3, 3), (3, 3)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return jnp.sum(y ** 2)
+
+    gx1, gw1 = jax.grad(f_rewrite, argnums=(0, 1))(x, w)
+    gx2, gw2 = jax.grad(f_direct, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(gx1, gx2, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gw1, gw2, rtol=1e-4, atol=1e-4)
+
+
 def test_pooled_size_ceil_mode():
     # layer.cc:496-500: pooled = ceil((size - kernel)/stride) + 1
     assert ops.pooled_size(28, 2, 2) == 14
