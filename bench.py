@@ -263,10 +263,19 @@ def bench_resnet50(n1=20, n2=60, batch=128):
 
 def bench_lm_longctx(n1=64, n2=256):
     """tinylm at S=8192 (batch 1): the long-context regime where the
-    S x S score tensor exceeds the dense budget and the auto-blocked
-    Pallas flash kernel carries the attention (BASELINE.md r3)."""
+    S x S score tensor exceeds the dense budget and the staged-K/V
+    Pallas flash kernel carries the attention (BASELINE.md r3/r4)."""
     return bench_tinylm(
         n1, n2, seq_len=8192, batch=1, n_samples=32, name="lm_longctx"
+    )
+
+
+def bench_lm_32k(n1=16, n2=48):
+    """tinylm at S=32768 (batch 1): K/V exceed the VMEM staging budget,
+    so the HBM-streaming flash kernels carry the attention — a regime
+    the r3 kernel could not run (BASELINE.md r4)."""
+    return bench_tinylm(
+        n1, n2, seq_len=32768, batch=1, n_samples=8, name="lm_32k"
     )
 
 
@@ -298,6 +307,7 @@ BENCHES = (
     ("cifar_alexnet", bench_cifar_alexnet),
     ("tinylm", bench_tinylm),
     ("lm_longctx", bench_lm_longctx),
+    ("lm_32k", bench_lm_32k),
     ("resnet50", bench_resnet50),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),
 )

@@ -5,10 +5,17 @@ ring attention (singa_tpu/parallel/ring.py) stitches across chips.
 The reference system predates transformers — no attention op exists
 anywhere in it (layer registry, src/worker/neuralnet.cc:13-33) — so this
 is a singa-tpu extension making long-context models first-class. The
-kernel follows the standard flash recipe: stream K/V blocks through VMEM,
-keep running (max, sum, output) statistics per query block so the S x S
-score matrix never materializes in HBM; the MXU sees (Bq, D) x (D, Bk)
-and (Bq, Bk) x (Bk, D) matmuls.
+kernels follow the standard flash recipe: process K/V blockwise with
+running (max, sum, output) statistics per query block so the S x S
+score matrix never materializes in HBM.
+
+Each of the three kernels (fwd, dq, dkv) ships in two variants chosen
+per call by K/V footprint (_variant): *staged* keeps the whole K/V in
+VMEM per program (fastest while it fits), *streamed* keeps K/V in HBM
+and double-buffers (D, block) slices through async DMA — VMEM holds
+O(block), so sequence length is bounded by HBM, not VMEM (measured
+S=131072 single-chip; ~50 TF/s flat across S=8k-131k on v5e, which is
+the d=64 MXU roofline — BASELINE.md r4).
 
 All shapes are (batch, heads, seq, head_dim).
 """
@@ -116,23 +123,261 @@ except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
 
+def _causal_nlive(q_offset, bq, block_k):
+    """Number of K blocks at or below a q block's diagonal — the causal
+    loop bound every kernel shares."""
+    return jax.lax.div(q_offset + bq - 1, block_k) + 1
+
+
+def _causal_first(k_offset, block_q):
+    """First q block that can see a k block (dkv kernels' loop start)."""
+    return jax.lax.div(k_offset, block_q)
+
+
+def _causal_mask(q_offset, bq, k_offset, bk, transposed=False):
+    """(Bq, Bk) keep-mask qpos >= kpos; (Bk, Bq) when ``transposed``."""
+    qpos = q_offset + jnp.arange(bq)
+    kpos = k_offset + jnp.arange(bk)
+    if transposed:
+        return qpos[None, :] >= kpos[:, None]
+    return qpos[:, None] >= kpos[None, :]
+
+
+def _stream(hbm, buf, sem, bh_idx):
+    """Double-buffered HBM->VMEM block streamer along the LAST axis of
+    ``hbm[bh_idx]``.
+
+    Streamed arrays put the sequence on the minor (lane) dimension so
+    every block slice is 128-aligned: K/V/Q/dO stream in transposed
+    (BH, D, S) layout (D=64 rides the 8-tiled sublanes — slicing the
+    64-wide minor dim of an (S, D) layout trips Mosaic's 128-lane tile
+    alignment), lse/delta rows in their native (BH, 1, S). ``buf`` is
+    (2, rows, block) VMEM scratch — the slot dim must stay a leading
+    batch dim (slicing a tiled sublane dim at width 1 is rejected), so
+    row vectors buffer as (2, 1, block). ``sem`` is a (2,) DMA
+    semaphore array. Returns (start, wait) taking (block_idx, slot).
+    """
+    block = buf.shape[-1]
+
+    def src(blk):
+        return hbm.at[bh_idx, :, pl.ds(blk * block, block)]
+
+    def start(blk, slot):
+        pltpu.make_async_copy(src(blk), buf.at[slot], sem.at[slot]).start()
+
+    def wait(blk, slot):
+        pltpu.make_async_copy(src(blk), buf.at[slot], sem.at[slot]).wait()
+
+    return start, wait
+
+
+def _db_loop(lo, hi, streams, compute):
+    """Run ``compute(blk, slot, carry)`` over blocks [lo, hi) with all
+    ``streams`` ((start, wait) pairs) double-buffered: block i+1's DMA
+    is in flight while block i computes."""
+
+    def starts(blk, slot):
+        for s, _ in streams:
+            s(blk, slot)
+
+    def body(blk, carry):
+        slot = jax.lax.rem(blk, 2)
+
+        @pl.when(blk + 1 < hi)
+        def _prefetch():
+            starts(blk + 1, jax.lax.rem(blk + 1, 2))
+
+        for _, w in streams:
+            w(blk, slot)
+        return compute(blk, slot, carry)
+
+    starts(lo, jax.lax.rem(lo, 2))
+    return lambda carry: jax.lax.fori_loop(lo, hi, body, carry)
+
+
 def _flash_kernel(
+    q_ref, k_hbm, v_hbm, o_ref, lse_ref, kbuf, vbuf, ksem, vsem,
+    *, causal, block_k,
+):
+    """One (batch*head, q-block) program; K/V stream from HBM.
+
+    K^T/V^T live in HBM ((BH, D, S) layout — see _stream) and are
+    pulled one (D, block_k) block at a time through double-buffered
+    async DMA — VMEM holds O(block), never O(S), so S is bounded by HBM
+    capacity, not VMEM (the r3 kernel staged the full K/V per program,
+    capping S near 64k). The causal loop bound skips fully-masked K
+    blocks entirely — their DMA never starts (a 3-D-grid formulation
+    measured ~2x slower here: dead blocks still pay DMA + grid latency).
+    The transposed layout also makes every matmul the natural MXU
+    orientation: q @ kt for scores, minor-minor contraction for p @ v.
+    lse is laid out (BH, 1, S) so every block index is static and
+    lane-aligned (Mosaic rejects dynamic sublane loads).
+    """
+    i = pl.program_id(0)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    seq_k = k_hbm.shape[2]
+    nk = seq_k // block_k
+    q_offset = qi * bq
+    if causal:
+        nlive = _causal_nlive(q_offset, bq, block_k)
+    else:
+        nlive = nk
+
+    q = q_ref[0].astype(jnp.float32)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    kst = _stream(k_hbm, kbuf, ksem, i)
+    vst = _stream(v_hbm, vbuf, vsem, i)
+
+    def compute(blk, slot, carry):
+        out, m, l = carry
+        kt = kbuf[slot].astype(jnp.float32)  # (D, Bk)
+        vt = vbuf[slot].astype(jnp.float32)
+        s = (q @ kt) * scale  # (Bq, Bk)
+        if causal:
+            mask = _causal_mask(q_offset, bq, blk * block_k, block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        # p @ v: contract Bk (minor of p) with Bk (minor of vt)
+        pv = jax.lax.dot_general(p, vt, (((1,), (1,)), ((), ())))
+        out = out * alpha[:, None] + pv
+        l = l * alpha + jnp.sum(p, axis=-1)
+        return out, m_new, l
+
+    out, m, l = _db_loop(0, nlive, [kst, vst], compute)(block_attn_init(q))
+    o_ref[0] = block_attn_finish(out, m, l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_hbm, v_hbm, dq_ref,
+    kbuf, vbuf, ksem, vsem, *, causal, block_k, scale,
+):
+    """dQ for one (batch*head, q-block) program; K^T/V^T stream from HBM.
+
+    FlashAttention backward recurrences: P = exp(S - lse),
+    dS = P * (dO V^T - D) with D = rowsum(dO * O), dQ = dS K * scale.
+    D arrives precomputed per row (like lse) so neither backward kernel
+    redoes the rowsum. Same double-buffered streaming + exact causal
+    loop bound as the forward.
+    """
+    i = pl.program_id(0)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]  # D, (Bq,)
+    bq, d = q.shape
+    seq_k = k_hbm.shape[2]
+    q_offset = qi * bq
+    if causal:
+        nlive = _causal_nlive(q_offset, bq, block_k)
+    else:
+        nlive = seq_k // block_k
+
+    kst = _stream(k_hbm, kbuf, ksem, i)
+    vst = _stream(v_hbm, vbuf, vsem, i)
+
+    def compute(blk, slot, dq):
+        kt = kbuf[slot].astype(jnp.float32)  # (D, Bk)
+        vt = vbuf[slot].astype(jnp.float32)
+        s = (q @ kt) * scale
+        if causal:
+            mask = _causal_mask(q_offset, bq, blk * block_k, block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        ds = p * (do @ vt - delta[:, None])
+        # ds @ k: contract Bk (minor of ds) with Bk (minor of kt)
+        dsk = jax.lax.dot_general(ds, kt, (((1,), (1,)), ((), ())))
+        return dq + dsk * scale
+
+    dq = _db_loop(0, nlive, [kst, vst], compute)(
+        jnp.zeros((bq, d), dtype=jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, v_ref, q_hbm, do_hbm, lse_hbm, delta_hbm, dk_ref, dv_ref,
+    qbuf, dobuf, lsebuf, dbuf, qsem, dosem, lsesem, dsem,
+    *, causal, block_q, scale,
+):
+    """dK/dV for one (batch*head, k-block) program; Q^T/dO^T/lse/D
+    stream from HBM.
+
+    dV = P^T dO; dK = (P * (dO V^T - D))^T Q * scale. With Q/dO
+    streaming in transposed (D, Bq) blocks, the kernel works on the
+    TRANSPOSED score matrix s_t[kk, qq] directly — k @ qt is the
+    natural orientation, and both accumulations contract the shared Bq
+    minor dim. The causal loop starts at the first q block that can see
+    this k block — earlier blocks' DMA never starts.
+    """
+    i = pl.program_id(0)
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    seq_q = q_hbm.shape[2]
+    nq = seq_q // block_q
+    k_offset = ki * bk
+    first = _causal_first(k_offset, block_q) if causal else 0
+
+    streams = [
+        _stream(q_hbm, qbuf, qsem, i),
+        _stream(do_hbm, dobuf, dosem, i),
+        _stream(lse_hbm, lsebuf, lsesem, i),
+        _stream(delta_hbm, dbuf, dsem, i),
+    ]
+
+    def compute(blk, slot, carry):
+        dk, dv = carry
+        qt = qbuf[slot].astype(jnp.float32)  # (D, Bq)
+        dot = dobuf[slot].astype(jnp.float32)
+        lse = lsebuf[slot][0]  # (Bq,)
+        delta = dbuf[slot][0]
+        s_t = (k @ qt) * scale  # (Bk, Bq): transposed scores
+        if causal:
+            mask = _causal_mask(
+                blk * block_q, block_q, k_offset, bk, transposed=True
+            )
+            s_t = jnp.where(mask, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - lse[None, :])  # (Bk, Bq)
+        # dO V^T transposed = V dO^T: (Bk, D) @ (D, Bq)
+        ds_t = p_t * (v @ dot - delta[None, :])
+        # contract Bq (minor of both): dk += ds^T q, dv += p^T do
+        dk = dk + jax.lax.dot_general(
+            ds_t, qt, (((1,), (1,)), ((), ()))
+        ) * scale
+        dv = dv + jax.lax.dot_general(p_t, dot, (((1,), (1,)), ((), ())))
+        return dk, dv
+
+    zeros = jnp.zeros((bk, d), dtype=jnp.float32)
+    dk, dv = _db_loop(first, nq, streams, compute)((zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ------------------- staged-K/V kernel variants -----------------------
+# For sequences whose K/V fit a VMEM budget, staging the whole K/V per
+# program (grid-pipelined BlockSpec, pl.ds loads) beats HBM streaming:
+# measured f+b at S=8192 (v5e, 8 heads, d=64): staged 4.9 ms vs
+# streamed 10.4 ms — short live ranges don't amortize per-block DMA.
+# Past the budget the streamed kernels take over (S is then bounded by
+# HBM, not VMEM): streamed 46-50 TF/s at S=32k-131k where staged
+# cannot run at all. Selection in _variant().
+
+
+def _flash_kernel_staged(
     q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k, seq_k
 ):
-    """One (batch*head, q-block) program: stream K/V blocks via VMEM.
-
-    Refs are (1, Bq, D) for q/o, (1, Sk, D) for k/v, (1, 1, Bq) for the
-    log-sum-exp rows (the backward kernels' softmax residual; the lse
-    array is laid out (BH, 1, S) so every block index is static and
-    lane-aligned — Mosaic rejects dynamic sublane loads); accumulation
-    in fp32 registers/VMEM values (flash statistics never touch HBM).
-    """
+    """One (batch*head, q-block) program; K/V staged whole in VMEM."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     bq, d = q.shape
-    out = jnp.zeros((bq, d), dtype=jnp.float32)
-    m = jnp.full((bq,), NEG_INF, dtype=jnp.float32)
-    l = jnp.zeros((bq,), dtype=jnp.float32)
     nblocks = seq_k // block_k
     q_offset = qi * bq
 
@@ -146,72 +391,61 @@ def _flash_kernel(
         )
 
     if causal:
-        # only K blocks at or below this q block's diagonal contribute
-        nblocks_live = jax.lax.div(q_offset + bq - 1, block_k) + 1
-        out, m, l = jax.lax.fori_loop(0, nblocks_live, body, (out, m, l))
+        nlive = _causal_nlive(q_offset, bq, block_k)
     else:
-        out, m, l = jax.lax.fori_loop(0, nblocks, body, (out, m, l))
+        nlive = nblocks
+    out, m, l = jax.lax.fori_loop(
+        0, nlive, body,
+        (jnp.zeros((bq, d), jnp.float32),
+         jnp.full((bq,), NEG_INF, jnp.float32),
+         jnp.zeros((bq,), jnp.float32)),
+    )
     o_ref[0] = block_attn_finish(out, m, l).astype(o_ref.dtype)
     lse_ref[0, 0] = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
 
 
-def _flash_bwd_dq_kernel(
+def _flash_bwd_dq_staged(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     *, causal, block_k, seq_k, scale,
 ):
-    """dQ for one (batch*head, q-block): stream K/V blocks.
-
-    FlashAttention backward recurrences: P = exp(S - lse),
-    dS = P * (dO V^T - D) with D = rowsum(dO * O), dQ = dS K * scale.
-    D arrives precomputed per row (like lse) so neither backward kernel
-    redoes the rowsum.
-    """
+    """dQ for one (batch*head, q-block) program; K/V staged in VMEM."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]  # D, (Bq,)
+    delta = delta_ref[0, 0]
     bq, d = q.shape
     q_offset = qi * bq
-    dq = jnp.zeros((bq, d), dtype=jnp.float32)
 
     def body(i, dq):
         k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k.T) * scale
         if causal:
-            qpos = q_offset + jnp.arange(bq)
-            kpos = i * block_k + jnp.arange(block_k)
-            mask = qpos[:, None] >= kpos[None, :]
+            mask = _causal_mask(q_offset, bq, i * block_k, block_k)
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         ds = p * (do @ v.T - delta[:, None])
         return dq + (ds @ k) * scale
 
-    nblocks = seq_k // block_k
     if causal:
-        nlive = jax.lax.div(q_offset + bq - 1, block_k) + 1
-        dq = jax.lax.fori_loop(0, nlive, body, dq)
+        nlive = _causal_nlive(q_offset, bq, block_k)
     else:
-        dq = jax.lax.fori_loop(0, nblocks, body, dq)
+        nlive = seq_k // block_k
+    dq = jax.lax.fori_loop(0, nlive, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(
+def _flash_bwd_dkv_staged(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     *, causal, block_q, seq_q, scale,
 ):
-    """dK/dV for one (batch*head, k-block): stream Q/dO/O blocks.
-
-    dV = P^T dO; dK = (P * (dO V^T - D))^T Q * scale.
-    """
+    """dK/dV for one (batch*head, k-block) program; Q/dO staged in VMEM."""
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     bk, d = k.shape
     k_offset = ki * bk
-    dk = jnp.zeros((bk, d), dtype=jnp.float32)
-    dv = jnp.zeros((bk, d), dtype=jnp.float32)
 
     def body(j, carry):
         dk, dv = carry
@@ -221,24 +455,28 @@ def _flash_bwd_dkv_kernel(
         delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
         s = (q @ k.T) * scale
         if causal:
-            qpos = j * block_q + jnp.arange(block_q)
-            kpos = k_offset + jnp.arange(bk)
-            mask = qpos[:, None] >= kpos[None, :]
+            mask = _causal_mask(j * block_q, block_q, k_offset, bk)
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (Bq, Bk)
+        p = jnp.exp(s - lse[:, None])
         ds = p * (do @ v.T - delta[:, None])
         return dk + (ds.T @ q) * scale, dv + p.T @ do
 
     nblocks = seq_q // block_q
-    if causal:
-        # q blocks strictly above this k block's diagonal see only masked
-        # scores; start at the first contributing block
-        first = jax.lax.div(k_offset, block_q)
-        dk, dv = jax.lax.fori_loop(first, nblocks, body, (dk, dv))
-    else:
-        dk, dv = jax.lax.fori_loop(0, nblocks, body, (dk, dv))
+    first = _causal_first(k_offset, block_q) if causal else 0
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, nblocks, body, (zeros, zeros))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _variant(s: int, d: int, dtype) -> str:
+    """'staged' while K+V for one head row fit the VMEM budget
+    (SINGA_TPU_FLASH_STAGE_MB, default 8), else 'streamed'."""
+    import os
+
+    budget = float(os.environ.get("SINGA_TPU_FLASH_STAGE_MB", "8")) * 1e6
+    kv_bytes = 2 * s * d * jnp.dtype(dtype).itemsize
+    return "staged" if kv_bytes <= budget else "streamed"
 
 
 def _auto_block(s: int) -> int:
@@ -280,10 +518,12 @@ def _use_kernel(q, k, block_q, block_k, interpret):
         return False
     if s % block_q or s % block_k:
         return False
-    if not interpret and block_q % 128:
-        # on real hardware the lse lane dimension is blocked by block_q,
-        # and Mosaic requires lane blocks in multiples of 128 (the
-        # interpreter is laxer — tests exercise smaller geometries there)
+    if not interpret and (block_q % 128 or block_k % 128):
+        # on real hardware Mosaic requires lane blocks in multiples of
+        # 128: the lse lane dimension is blocked by block_q, and the
+        # streamed variant slices the lane (S) dim of the transposed
+        # K/V in block_k chunks (the interpreter is laxer — tests
+        # exercise smaller geometries there)
         return False
     if interpret is None:
         return jax.default_backend() == "tpu"
@@ -301,27 +541,50 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     qf = q.reshape(bh, s, d)
     kf = k.reshape(bh, s, d)
     vf = v.reshape(bh, s, d)
-    kernel = functools.partial(
-        _flash_kernel, causal=causal, block_k=block_k, seq_k=s
-    )
+    qblk = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    lse_blk = pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j))
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+    ]
+    if _variant(s, d, k.dtype) == "staged":
+        out, lse = pl.pallas_call(
+            functools.partial(
+                _flash_kernel_staged,
+                causal=causal, block_k=block_k, seq_k=s,
+            ),
+            grid=(bh, s // block_q),
+            in_specs=[
+                qblk,
+                pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[qblk, lse_blk],
+            out_shape=out_shape,
+            interpret=bool(interpret),
+        )(qf, kf, vf)
+        return out.reshape(b, h, s, d), lse
+    # streamed: K/V stay in HBM in transposed (BH, D, S) layout (see
+    # _stream); the transposes are one XLA pass over K/V, outside the
+    # kernel
     out, lse = pl.pallas_call(
-        kernel,
+        functools.partial(_flash_kernel, causal=causal, block_k=block_k),
         grid=(bh, s // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            qblk,
+            pl.BlockSpec(memory_space=pltpu.HBM),  # K^T stays in HBM
+            pl.BlockSpec(memory_space=pltpu.HBM),  # V^T stays in HBM
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        out_specs=[qblk, lse_blk],
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((2, d, block_k), k.dtype),
+            pltpu.VMEM((2, d, block_k), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=bool(interpret),
-    )(qf, kf, vf)
+    )(qf, jnp.swapaxes(kf, 1, 2), jnp.swapaxes(vf, 1, 2))
     return out.reshape(b, h, s, d), lse
 
 
@@ -351,37 +614,88 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
         flat(g).astype(jnp.float32) * flat(out).astype(jnp.float32),
         axis=-1,
     )[:, None, :]
-    args = (flat(q), flat(k), flat(v), flat(g), lse, delta)
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
-    full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
+    hbm = pl.BlockSpec(memory_space=pltpu.HBM)
     lse_blk = pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j))
-    lse_full = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))
+    if _variant(s, d, k.dtype) == "staged":
+        args = (flat(q), flat(k), flat(v), flat(g), lse, delta)
+        full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
+        lse_full = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))
+        dq = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dq_staged,
+                causal=causal, block_k=block_k, seq_k=s, scale=scale,
+            ),
+            grid=(bh, s // block_q),
+            in_specs=[qspec, full, full, qspec, lse_blk, lse_blk],
+            out_specs=qspec,
+            out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            interpret=bool(interpret),
+        )(*args)
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dkv_staged,
+                causal=causal, block_q=block_q, seq_q=s, scale=scale,
+            ),
+            grid=(bh, s // block_k),
+            in_specs=[full, kspec, kspec, full, lse_full, lse_full],
+            out_specs=[kspec, kspec],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            ],
+            interpret=bool(interpret),
+        )(*args)
+        unflat = lambda x: x.reshape(b, h, s, d)  # noqa: E731
+        return unflat(dq), unflat(dk), unflat(dv)
+    kt = jnp.swapaxes(flat(k), 1, 2)  # streamed layouts (see _stream)
+    vt = jnp.swapaxes(flat(v), 1, 2)
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel,
-            causal=causal, block_k=block_k, seq_k=s, scale=scale,
+            causal=causal, block_k=block_k, scale=scale,
         ),
         grid=(bh, s // block_q),
-        in_specs=[qspec, full, full, qspec, lse_blk, lse_blk],
+        in_specs=[qspec, qspec, lse_blk, lse_blk, hbm, hbm],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, d, block_k), k.dtype),
+            pltpu.VMEM((2, d, block_k), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
         interpret=bool(interpret),
-    )(*args)
+    )(flat(q), flat(g), lse, delta, kt, vt)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel,
-            causal=causal, block_q=block_q, seq_q=s, scale=scale,
+            causal=causal, block_q=block_q, scale=scale,
         ),
         grid=(bh, s // block_k),
-        in_specs=[full, kspec, kspec, full, lse_full, lse_full],
+        in_specs=[kspec, kspec, hbm, hbm, hbm, hbm],
         out_specs=[kspec, kspec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k.dtype),
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((2, d, block_q), q.dtype),
+            pltpu.VMEM((2, d, block_q), g.dtype),
+            pltpu.VMEM((2, 1, block_q), jnp.float32),
+            pltpu.VMEM((2, 1, block_q), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
         interpret=bool(interpret),
-    )(*args)
+    )(
+        flat(k), flat(v),
+        jnp.swapaxes(flat(q), 1, 2), jnp.swapaxes(flat(g), 1, 2),
+        lse, delta,
+    )
     unflat = lambda x: x.reshape(b, h, s, d)  # noqa: E731
     return unflat(dq), unflat(dk), unflat(dv)
 

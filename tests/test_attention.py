@@ -79,6 +79,35 @@ class TestFlashKernel:
                 np.asarray(a), np.asarray(b), atol=2e-4, err_msg=f"d{name}"
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_streamed_variant_matches_dense(self, causal, monkeypatch):
+        """Force the HBM-streaming kernels (the long-context path that
+        staged K/V cannot serve) and pin values AND all three grads
+        against the dense reference."""
+        monkeypatch.setenv("SINGA_TPU_FLASH_STAGE_MB", "0")
+        q, k, v = qkv((1, 2, 256, 32))
+        g = jnp.asarray(
+            np.random.RandomState(11).randn(1, 2, 256, 32).astype(np.float32)
+        )
+
+        def f_flash(q, k, v):
+            return jnp.vdot(flash_attention(q, k, v, causal, 64, 64, True), g)
+
+        def f_ref(q, k, v):
+            return jnp.vdot(attention(q, k, v, causal=causal), g)
+
+        np.testing.assert_allclose(
+            np.asarray(flash_attention(q, k, v, causal, 64, 64, True)),
+            np.asarray(attention(q, k, v, causal=causal)),
+            atol=1e-4,
+        )
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4, err_msg=f"d{name}"
+            )
+
     def test_cross_attention_lengths_fall_back(self):
         """Sq != Sk (e.g. cross-attention / decode) must hit the dense
         path, which supports it, instead of crashing in the kernel."""
