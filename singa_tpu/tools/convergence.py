@@ -11,7 +11,7 @@ reference length and width:
   alexnet     structured synthetic RGB (kron-upsampled class templates,
               5000 train / 1000 test with disjoint noise)
 
-Usage:  python -m singa_tpu.tools.convergence [mlp conv alexnet]
+Usage:  python -m singa_tpu.tools.convergence [mlp mlp_elastic conv alexnet]
 
 Prints one JSON line per workload: {name, steps, wall_sec,
 steps_per_sec, final_test_accuracy, final_test_loss} — the convergence
@@ -64,14 +64,27 @@ def _patch_paths(cfg, train: str, test: str, mean: str | None = None):
 
 
 def run_workload(name: str, log=print) -> dict:
-    from ..config import load_model_config
-    from ..trainer import Trainer
+    from ..config import load_cluster_config, load_model_config
+    from ..trainer import Trainer, make_trainer
 
     tmp = tempfile.mkdtemp(prefix=f"singa_tpu_conv_{name}_")
-    if name == "mlp":
+    cluster = None
+    if name in ("mlp", "mlp_elastic"):
+        # same job conf both ways, like the reference: mlp.conf declares
+        # param_type "Elastic" (reference mlp.conf:13); the cluster conf
+        # picks the engine — async+nservers routes to the ReplicaTrainer
+        # running the declared protocol, the default synchronous cluster
+        # runs the north-star sync ParamSync engine
         cfg = load_model_config(
             os.path.join(REPO, "examples", "mnist", "mlp.conf")
         )
+        if name == "mlp_elastic":
+            cluster = load_cluster_config(
+                os.path.join(
+                    REPO, "examples", "mnist", "cluster_elastic.conf"
+                )
+            )
+            cluster.workspace = tmp
         _patch_paths(cfg, *_digits_shards(tmp))
     elif name == "conv":
         cfg = load_model_config(
@@ -98,7 +111,10 @@ def run_workload(name: str, log=print) -> dict:
         # on these workloads' scale).
         cfg.compute_dtype = "bfloat16"
 
-    trainer = Trainer(cfg, seed=0, log=log, prefetch=False)
+    if cluster is not None:
+        trainer = make_trainer(cfg, cluster, seed=0, log=log, prefetch=False)
+    else:
+        trainer = Trainer(cfg, seed=0, log=log, prefetch=False)
     t0 = time.perf_counter()
     trainer.run()
     wall = time.perf_counter() - t0
@@ -114,13 +130,14 @@ def run_workload(name: str, log=print) -> dict:
         "steps": cfg.train_steps,
         "wall_sec": round(wall, 1),
         "steps_per_sec": round(cfg.train_steps / wall, 1),
+        "engine": type(trainer).__name__,
         "final_test_accuracy": round(float(m["precision"]), 4),
         "final_test_loss": round(float(m["loss"]), 4),
     }
 
 
 def main(argv: list[str]) -> int:
-    names = argv or ["mlp", "conv", "alexnet"]
+    names = argv or ["mlp", "mlp_elastic", "conv", "alexnet"]
     quiet = lambda s: None  # noqa: E731
     for name in names:
         result = run_workload(name, log=quiet)

@@ -107,27 +107,22 @@ class CDTrainer(Trainer):
         state = {**state, **new_s}
         return params, state, buffers, metrics
 
-    def _eval_step_for(self, net):
-        """Eval metric per RBM: mean-field reconstruction error."""
-        if id(net) not in self._eval_steps:
+    def _eval_batch_metrics(self, net, params, buffers, batch) -> dict:
+        """Eval metric per RBM: mean-field reconstruction error.
 
-            def eval_fn(params, buffers, batch):
-                del buffers  # CD nets carry no stateful layers
-                batch = self._resolve_batch(net, batch)
-                metrics: dict = {}
+        Overrides the base seam, so both the per-step eval loop and the
+        chunked eval scan compute CD metrics."""
+        del buffers  # CD nets carry no stateful layers
+        batch = self._resolve_batch(net, batch)
+        metrics: dict = {}
 
-                def hook(layer, resolved, inputs, lrng):
-                    if isinstance(layer, RBMLayer):
-                        metrics[layer.name] = {
-                            "loss": layer.recon_error(resolved, inputs[0])
-                        }
-                        return layer.prop_up(resolved, inputs[0])
-                    return None
+        def hook(layer, resolved, inputs, lrng):
+            if isinstance(layer, RBMLayer):
+                metrics[layer.name] = {
+                    "loss": layer.recon_error(resolved, inputs[0])
+                }
+                return layer.prop_up(resolved, inputs[0])
+            return None
 
-                net.forward(
-                    params, batch, training=False, layer_hook=hook
-                )
-                return metrics
-
-            self._eval_steps[id(net)] = jax.jit(eval_fn)
-        return self._eval_steps[id(net)]
+        net.forward(params, batch, training=False, layer_hook=hook)
+        return metrics

@@ -198,6 +198,7 @@ class Trainer:
         # per cadence window instead of per batch (cache keyed by length)
         self._chunk_fns: dict[int, Callable] = {}
         self._eval_steps: dict[int, Callable] = {}
+        self._eval_chunk_fns: dict[tuple[int, int], Callable] = {}
         self._batch_size = self.train_net.batchsize
 
     # ------------------------------------------------------------------
@@ -430,16 +431,24 @@ class Trainer:
         )
         return params, state, new_buffers, metrics
 
+    def _eval_batch_metrics(self, net: Net, params, buffers, batch) -> dict:
+        """One eval batch -> {losslayer: metrics}. The single overridable
+        seam both eval paths share (per-step _eval_step_for and the
+        chunked scan body) — subclasses with custom eval semantics (the
+        CD trainer's per-RBM reconstruction error) override THIS, and
+        both paths follow."""
+        batch = self._resolve_batch(net, batch)
+        _, metrics = net.forward(
+            self._cast_compute(params), self._cast_compute(batch),
+            training=False, buffers=buffers,
+        )
+        return metrics
+
     def _eval_step_for(self, net: Net) -> Callable:
         if id(net) not in self._eval_steps:
 
             def eval_fn(params, buffers, batch):
-                batch = self._resolve_batch(net, batch)
-                _, metrics = net.forward(
-                    self._cast_compute(params), self._cast_compute(batch),
-                    training=False, buffers=buffers,
-                )
-                return metrics
+                return self._eval_batch_metrics(net, params, buffers, batch)
 
             self._eval_steps[id(net)] = jax.jit(eval_fn)
         return self._eval_steps[id(net)]
@@ -499,10 +508,17 @@ class Trainer:
     def _chunk_cap(self) -> int:
         return int(os.environ.get("SINGA_TPU_CHUNK", "64"))
 
+    @staticmethod
+    def _flat_batch_indices(pos0, i, bs: int, n: int):
+        """Sequential-wraparound record indices of batch ``i`` from
+        stream position ``pos0`` — the base stream-index math shared by
+        the train chunk and the (always-flat) eval chunk."""
+        return (pos0 + i * bs + jnp.arange(bs)) % n
+
     def _chunk_batch_indices(self, pos0, i, bs: int, n: int):
         """Record indices of scan-iteration ``i``'s batch (the replica
         trainer overrides with a (replicas, batch) grid)."""
-        return (pos0 + i * bs + jnp.arange(bs)) % n
+        return self._flat_batch_indices(pos0, i, bs, n)
 
     def _make_chunk_fn(self, nsteps: int) -> Callable:
         pipes = self._pipelines[id(self.train_net)]
@@ -613,17 +629,71 @@ class Trainer:
         0's running stats)."""
         return self.buffers
 
+    def _make_eval_chunk_fn(self, net: Net, nsteps: int) -> Callable:
+        """One compiled program for a whole eval cadence: scan nsteps
+        batches (on-device index math, like _make_chunk_fn) and sum the
+        metrics inside the program. The r3 eval path dispatched per
+        batch; through the tunnel those round trips dominated the
+        flagship 60k-step run's wall clock (BASELINE.md r3 note)."""
+        pipes = self._pipelines[id(net)]
+        meta = {
+            name: (pipes[name].batchsize, pipes[name].n)
+            for name in self._dev_data[id(net)]
+        }
+
+        def chunk_fn(params, buffers, pos0s, data):
+            def body(carry, i):
+                batch = {}
+                for name, d in data.items():
+                    bs, n = meta[name]
+                    # eval streams are always flat (no replica grid) —
+                    # deliberately the base index math, not
+                    # _chunk_batch_indices
+                    idx = self._flat_batch_indices(pos0s[name], i, bs, n)
+                    batch[name] = {"__idx__": idx, **d}
+                metrics = self._eval_batch_metrics(
+                    net, params, buffers, batch
+                )
+                return carry, metrics
+
+            _, metrics = jax.lax.scan(body, 0, jnp.arange(nsteps))
+            return jax.tree.map(lambda a: a.sum(axis=0), metrics)
+
+        return jax.jit(chunk_fn)
+
     def evaluate(self, net: Net, nsteps: int, phase: str, step: int) -> dict:
         """Test/Validate (worker.cc:318-348): nsteps batches, averaged."""
-        fn = self._eval_step_for(net)
         perf = Performance()
         eval_params = self._eval_params()
         eval_buffers = self._eval_buffers()
-        with self.timers.phase("eval"):
-            for _ in range(nsteps):
-                perf.update(
-                    fn(eval_params, eval_buffers, self._next_batch(net))
+        # same opt-outs as the train chunk (_can_chunk: device cache,
+        # cfg.debug, SINGA_TPU_CHUNK=1 escape hatch)
+        if self._can_chunk() and nsteps > 1 and id(net) in self._dev_data:
+            key = (id(net), nsteps)
+            if key not in self._eval_chunk_fns:
+                self._eval_chunk_fns[key] = self._make_eval_chunk_fn(
+                    net, nsteps
                 )
+            pipes = self._pipelines[id(net)]
+            pos0s = {
+                name: jnp.int32(pipe.position)
+                for name, pipe in pipes.items()
+            }
+            with self.timers.phase("eval"):
+                summed = self._eval_chunk_fns[key](
+                    eval_params, eval_buffers, pos0s,
+                    self._dev_data[id(net)],
+                )
+            for pipe in pipes.values():
+                pipe.advance(nsteps)
+            perf.update_summed(summed, nsteps)
+        else:
+            fn = self._eval_step_for(net)
+            with self.timers.phase("eval"):
+                for _ in range(nsteps):
+                    perf.update(
+                        fn(eval_params, eval_buffers, self._next_batch(net))
+                    )
         avg = perf.avg()
         self.log(f"step {step}: {phase} {perf.to_string()}")
         return avg

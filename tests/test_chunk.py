@@ -91,6 +91,48 @@ def test_chunked_run_equals_stepwise_run(shard):
         )
 
 
+def test_chunked_eval_equals_stepwise_eval(shard, tmp_path):
+    """evaluate() through the one-dispatch scan chunk == the per-batch
+    dispatch loop: same averaged metrics, same stream positions."""
+    import copy
+
+    test_shard = str(tmp_path / "test_shard")
+    write_records(test_shard, *synthetic_arrays(48, seed=7))
+    cfg_a = _conf(shard, "test_steps: 3")
+    cfg_b = _conf(shard, "test_steps: 3")
+    for cfg in (cfg_a, cfg_b):
+        # add a test-phase data layer pointing at the eval shard
+        data = copy.deepcopy(cfg.neuralnet.layer[0])
+        data.data_param.path = test_shard
+        data.exclude = ["kTrain"]
+        cfg.neuralnet.layer[0].exclude = ["kTest"]
+        cfg.neuralnet.layer.insert(1, data)
+    a = Trainer(cfg_a, seed=3, log=lambda s: None, prefetch=False)
+    b = Trainer(cfg_b, seed=3, log=lambda s: None, prefetch=False)
+    assert a._cached and b._cached
+    # a: chunked (default); b: driven through the per-step machinery
+    avg_a = a.evaluate(a.test_net, 3, "test", 0)
+    fn = b._eval_step_for(b.test_net)
+    from singa_tpu.utils.metrics import Performance
+
+    perf = Performance()
+    for _ in range(3):
+        perf.update(
+            fn(b._eval_params(), b._eval_buffers(), b._next_batch(b.test_net))
+        )
+    avg_b = perf.avg()
+    assert (a._eval_chunk_fns), "chunked eval path never engaged"
+    for lname in avg_b:
+        for metric in avg_b[lname]:
+            np.testing.assert_allclose(
+                avg_a[lname][metric], avg_b[lname][metric],
+                rtol=1e-5, atol=1e-6, err_msg=f"{lname}/{metric}",
+            )
+    (pa,) = a._pipelines[id(a.test_net)].values()
+    (pb,) = b._pipelines[id(b.test_net)].values()
+    assert pa.position == pb.position
+
+
 def test_chunk_respects_cadences(shard):
     """Chunks stop at test/display boundaries; events still fire."""
     extra = """
