@@ -334,11 +334,30 @@ def main() -> int:
         (w for w in workloads if w.get("name") == "mnist_mlp" and "value" in w),
         None,
     )
+    if head is None and only and "mnist_mlp" not in only:
+        # headline workload deliberately not selected: promote the first
+        # measured workload instead of reporting a misreadable 0.0
+        promoted = next((w for w in workloads if "value" in w), None)
+        out = {
+            "metric": (
+                f"{promoted['name']}_train_throughput" if promoted
+                else "mnist_mlp_train_throughput"
+            ),
+            "value": promoted["value"] if promoted else None,
+            "unit": promoted["unit"] if promoted else "samples/sec",
+            "vs_baseline": None,  # baseline is the MNIST MLP number
+            "baseline_note": BASELINE_NOTE,
+            "workloads": workloads,
+        }
+        print(json.dumps(out))
+        return 0 if promoted else 1
     out = {
         "metric": "mnist_mlp_train_throughput",
-        "value": head["value"] if head else 0.0,
+        "value": head["value"] if head else None,
         "unit": "samples/sec",
-        "vs_baseline": round(head["value"] / BASELINE_SPS, 3) if head else 0.0,
+        "vs_baseline": (
+            round(head["value"] / BASELINE_SPS, 3) if head else None
+        ),
         "baseline_note": BASELINE_NOTE,
         "workloads": workloads,
     }

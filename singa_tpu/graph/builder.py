@@ -73,6 +73,11 @@ class Net:
         #: the net places layers by locationid (graph/pipeline_plan.py)
         self.pipeline_plan = None
         self.pipeline_mesh = None
+        #: {param name: logical shape} for params whose STORED arrays are
+        #: pad-to-multiple for an indivisible kLayerPartition dim
+        #: (parallel/shardings.py param_paddings); forward slices the
+        #: stored array back to the logical shape before layers see it
+        self.param_logical: dict[str, tuple] = {}
         self.name2layer = {l.name: l for l in layers}
         self.datalayers = [l for l in layers if l.is_datalayer]
         self.parserlayers = [l for l in layers if l.is_parserlayer]
@@ -172,6 +177,17 @@ class Net:
             for name, spec in layer.param_specs().items():
                 if spec.owner is not None:
                     resolved[name] = params[spec.owner]
+        # pad-to-multiple storage (uneven kLayerPartition dims): slice
+        # back to the logical shape. Ellipsis keeps any leading replica
+        # axis (ReplicaTrainer stacks params as (R, ...)). The slice of
+        # the zero tail has zero cotangent, so gradients/updater slots
+        # on the tail stay exactly zero.
+        for name, logical in self.param_logical.items():
+            v = resolved.get(name)
+            if v is not None and v.shape[-len(logical):] != tuple(logical):
+                resolved[name] = v[
+                    (Ellipsis, *(slice(0, s) for s in logical))
+                ]
 
         acts: dict[str, Any] = {}
         slice_cursor: dict[str, int] = {}

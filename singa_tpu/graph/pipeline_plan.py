@@ -4,10 +4,17 @@ The reference's ``locationid`` places layers on different workers with
 blocking bridge handshakes and no microbatch interleaving
 (base_layer.h:151-165; SURVEY §2.5 "layer placement without
 pipelining"). Here the same config field drives the real thing: layers
-sharing a locationid form a pipeline stage, stage params shard over the
-cluster's pipe mesh axis (npipes_per_group), and the schedule is
+sharing a locationid form a pipeline stage, and the schedule is
 parallel/pipeline.py's GPipe scan — activations hop stage-to-stage over
 ICI ppermute while every stage works on a different microbatch.
+
+Scope honesty: what is pipelined is the IN-STEP COMPUTE. Stage params
+are STORED replicated (param_shardings has no pipe-axis placement;
+stack_stage_params restacks them inside each jitted step under a pipe
+sharding constraint), so pipeline parallelism here does not yet reduce
+per-device parameter/optimizer MEMORY — the stacked-storage layout
+(params held as (P, ...) leaves sharded over pipe end-to-end, with
+updater slots and checkpoints following) is the known next step.
 
 Contract (validated by plan_stages, errors cite this module):
   * locationids are exactly 0..P-1 where P = the pipe axis width;

@@ -184,7 +184,16 @@ class AttentionLayer(Layer):
             # whenever the scores fit; the kernel is for long context)
             from ..ops.attention import auto_attention
 
-            n_dev = self.mesh.size if self.mesh is not None else 1
+            # footprint divisor: only axes that actually shard the
+            # (B, H, S, S) score tensor — batch over data, seq over seq,
+            # heads over model. Pipe/expert axes REPLICATE attention
+            # compute, so counting them (mesh.size) would underestimate
+            # the per-device footprint and pick dense attention in
+            # regimes where the scores exceed per-device HBM.
+            n_dev = 1
+            if self.mesh is not None:
+                for axis in ("data", "seq", "model"):
+                    n_dev *= self.mesh.shape.get(axis, 1)
             o = auto_attention(q, k, v, causal=True, n_devices=n_dev)
         else:
             o = attention(q, k, v, causal=True)

@@ -35,6 +35,7 @@ from .moe import (
 from .pipeline import build_pp_mesh, pipeline_apply, stage_param_shardings
 from .shardings import (
     batch_shardings,
+    param_paddings,
     param_shardings,
     replicated,
     state_shardings,
@@ -58,6 +59,7 @@ __all__ = [
     "pipeline_apply",
     "stage_param_shardings",
     "batch_shardings",
+    "param_paddings",
     "param_shardings",
     "replicated",
     "state_shardings",

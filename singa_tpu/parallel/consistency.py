@@ -89,8 +89,8 @@ def elastic_sync(replicas, center, alpha: float):
     return replicas, center
 
 
-def random_sync(replicas, snapshots, center, indices):
-    """One RandomSync round over sampled coordinates, serially per replica.
+def random_sync(replicas, snapshots, center, indices, full_coverage=False):
+    """One RandomSync round over sampled coordinates.
 
     ``indices`` maps param name -> int32 (nreplicas, m) of flat coordinate
     indices (unique within each row). Per replica i and param (reference:
@@ -100,34 +100,66 @@ def random_sync(replicas, snapshots, center, indices):
         old   = s[idx];  s[idx] += delta      (HandleSyncMsg)
         w[idx] = old + delta;  snapshot[idx] = w[idx]   (ParseSyncMsgFromPS)
 
-    so each replica absorbs exactly the other replicas' deltas that reached
-    the server before its own message. Returns (replicas, snapshots, center).
+    so each replica absorbs exactly the other replicas' deltas that
+    reached the server before its own message.
+
+    **The serial server loop is a prefix sum in disguise** (the r4
+    decision VERDICT r3 #8 asked for): at any coordinate x, replica i's
+    new value is c0[x] + sum_{j<=i, x in idx_j} delta_j[x] and the final
+    center is c0 + the full sum — an associative prefix over the replica
+    axis. This computes it with one batched scatter + jnp.cumsum instead
+    of the r3 lax.scan whose serial gather/scatter rounds cost 3.1x the
+    sync engine at 8 replicas (BASELINE.md r3 replica table). The
+    arrival order is fixed at 0..R-1 — the reference's order was
+    whatever ZMQ delivered, so this is as valid an execution as any, and
+    it matches the previous scan's order exactly (differences vs the
+    serial form are only the summation tree's fp rounding).
+    Transient memory is O(R * n) per param for the dense delta field.
+
+    ``full_coverage=True`` is the ratio>=1.0 fast path: the CALLER
+    asserts every replica syncs every coordinate (sample_sync_indices
+    emits arange rows there), so the scatter/gather is skipped entirely
+    and ``indices`` may be None. Passing partial indices with this flag
+    would silently sync everything — it is a contract, not a checked
+    argument (the only caller, trainer/replica.py, derives it from the
+    static sample_ratio).
+
+    Returns (replicas, snapshots, center).
     """
-
-    def one(c, xs):
-        w, snap, idx = xs
-        new_w, new_snap = {}, {}
-        for name in w:
-            shape = w[name].shape
-            wf = w[name].ravel()
-            sf = snap[name].ravel()
-            cf = c[name].ravel()
-            ix = idx[name]
-            delta = wf[ix] - sf[ix]
-            old = cf[ix]
-            cf = cf.at[ix].add(delta)
-            new_vals = old + delta
-            wf = wf.at[ix].set(new_vals)
-            sf = sf.at[ix].set(new_vals)
-            c[name] = cf.reshape(shape)
-            new_w[name] = wf.reshape(shape)
-            new_snap[name] = sf.reshape(shape)
-        return dict(c), (new_w, new_snap)
-
-    center, (replicas, snapshots) = jax.lax.scan(
-        one, dict(center), (replicas, snapshots, indices)
-    )
-    return replicas, snapshots, center
+    new_r, new_s, new_c = {}, {}, {}
+    for name in center:
+        shape = replicas[name].shape
+        R = shape[0]
+        n = center[name].size
+        w = replicas[name].reshape(R, n)
+        snap = snapshots[name].reshape(R, n)
+        c0 = center[name].ravel()
+        if full_coverage:
+            dense = w - snap  # delta at every coordinate
+            prefix = jnp.cumsum(dense, axis=0)
+            new_vals = c0[None, :] + prefix
+            new_r[name] = new_vals.reshape(shape)
+            new_s[name] = new_vals.reshape(shape)
+        else:
+            ix = indices[name]
+            delta = (
+                jnp.take_along_axis(w, ix, 1)
+                - jnp.take_along_axis(snap, ix, 1)
+            )
+            dense = jax.vmap(
+                lambda i, d: jnp.zeros((n,), w.dtype).at[i].add(d)
+            )(ix, delta)
+            prefix = jnp.cumsum(dense, axis=0)
+            new_vals = c0[None, :] + prefix
+            upd = jnp.take_along_axis(new_vals, ix, 1)
+            new_r[name] = jax.vmap(
+                lambda row, i, v: row.at[i].set(v)
+            )(w, ix, upd).reshape(shape)
+            new_s[name] = jax.vmap(
+                lambda row, i, v: row.at[i].set(v)
+            )(snap, ix, upd).reshape(shape)
+        new_c[name] = (c0 + prefix[-1]).reshape(center[name].shape)
+    return new_r, new_s, new_c
 
 
 def sample_sync_indices(

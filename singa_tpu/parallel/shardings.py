@@ -50,29 +50,31 @@ def batch_shardings(mesh: Mesh, net: Net) -> dict:
     return out
 
 
-def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
-    """Per-param shardings implementing the layer's partition_type.
+def _param_layout(mesh: Mesh, net: Net):
+    """-> iterator of (name, spec, sharded_axis | None, pad).
 
-    Only layers whose partition_dim is 1 (kLayerPartition) shard their
-    params, along each param's neuron_axis; everything else replicates
-    (data-parallel grads sync via psum, which GSPMD inserts because the
-    loss is a mean over the sharded batch dim).
+    ``sharded_axis`` is the param dim sharded over a mesh axis (with the
+    axis name), ``pad`` the extra length the STORED array needs on that
+    dim so jax's even-shard requirement holds. kLayerPartition neuron
+    dims honor the reference's uneven-partition contract by
+    pad-to-multiple (the reference gives the last partition the
+    remainder, neuralnet.cc:160-162; padding the last shard is the GSPMD
+    expression of the same split — Net.forward slices the tail back off
+    before any layer sees it). Expert axes never pad: a phantom expert
+    would need routing masks, so indivisible expert counts replicate.
     """
     nmodel = mesh.shape[MODEL_AXIS]
     nexpert = dict(mesh.shape).get("expert", 1)
-    out: dict[str, NamedSharding] = {}
     for layer in net.layers:
         for name, spec in layer.param_specs().items():
-            sharding = replicated(mesh)
             if (
                 layer.partition_dim == 1
                 and spec.neuron_axis is not None
                 and nmodel > 1
-                and spec.shape[spec.neuron_axis] % nmodel == 0
             ):
-                axes: list = [None] * len(spec.shape)
-                axes[spec.neuron_axis] = MODEL_AXIS
-                sharding = NamedSharding(mesh, P(*axes))
+                d = spec.shape[spec.neuron_axis]
+                pad = -d % nmodel
+                yield name, spec, (spec.neuron_axis, MODEL_AXIS), pad
             elif (
                 spec.expert_axis is not None
                 and nexpert > 1
@@ -81,10 +83,46 @@ def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
                 # kMoE expert weights split over the expert axis
                 # regardless of partition_type — expert parallelism is
                 # the layer's intrinsic layout, not a net-wide choice
-                axes = [None] * len(spec.shape)
-                axes[spec.expert_axis] = "expert"
-                sharding = NamedSharding(mesh, P(*axes))
-            out[name] = sharding
+                yield name, spec, (spec.expert_axis, "expert"), 0
+            else:
+                yield name, spec, None, 0
+
+
+def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
+    """Per-param shardings implementing the layer's partition_type.
+
+    Only layers whose partition_dim is 1 (kLayerPartition) shard their
+    params, along each param's neuron_axis; everything else replicates
+    (data-parallel grads sync via psum, which GSPMD inserts because the
+    loss is a mean over the sharded batch dim). Indivisible neuron dims
+    are still sharded — the trainer pads their storage (see
+    param_paddings / _param_layout).
+    """
+    out: dict[str, NamedSharding] = {}
+    for name, spec, sharded, _pad in _param_layout(mesh, net):
+        if sharded is None:
+            out[name] = replicated(mesh)
+        else:
+            dim, axis = sharded
+            axes: list = [None] * len(spec.shape)
+            axes[dim] = axis
+            out[name] = NamedSharding(mesh, P(*axes))
+    return out
+
+
+def param_paddings(mesh: Mesh, net: Net) -> dict[str, tuple]:
+    """{name: np.pad-style widths} for params whose STORED array must be
+    longer than the logical shape (indivisible kLayerPartition dims).
+    Only padded params appear. The logical shape stays spec.shape;
+    Net.forward slices the stored array back down before layers see it.
+    """
+    out: dict[str, tuple] = {}
+    for name, spec, sharded, pad in _param_layout(mesh, net):
+        if pad:
+            dim = sharded[0]
+            widths = [(0, 0)] * len(spec.shape)
+            widths[dim] = (0, pad)
+            out[name] = tuple(widths)
     return out
 
 

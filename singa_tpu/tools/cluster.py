@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import shlex
 import signal
 import socket
@@ -174,15 +175,48 @@ def _alive(pid: int) -> bool:
         return False
 
 
+def _is_singa_main(pid: int) -> bool:
+    """Guard against recycled PIDs in stale pid files: only SIGTERM a
+    process whose cmdline is actually a singa_tpu.main run. Where the
+    check is impossible (no /proc — e.g. macOS), fall back to trusting
+    the pid file rather than refusing to stop live children."""
+    if not os.path.isdir("/proc"):
+        return True
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"singa_tpu" in f.read()
+    except OSError:  # pid's /proc entry gone
+        return False
+
+
+def _stop_scope_pattern(args) -> str:
+    """pkill -f pattern scoped to THIS job's children, not every
+    singa_tpu.main on the host: children carry -model_conf and
+    -hostfile as absolute paths on their cmdlines (see start())."""
+    tokens = []
+    if args.model_conf:
+        tokens.append(re.escape(os.path.abspath(args.model_conf)))
+    # either the operator's hostfile or the truncated copy start() wrote
+    # into the workspace
+    tokens.append(re.escape(os.path.abspath(args.hostfile)))
+    tokens.append(re.escape(os.path.join(_proc_dir(args.workspace), "hostfile")))
+    return f"singa_tpu[.]main.*({'|'.join(tokens)})"
+
+
 def stop(args) -> int:
     hosts = read_hostfile(args.hostfile)
     pids = _pids(args.workspace)
     for rank, (pidfile, pid) in sorted(pids.items()):
         host = hosts[rank] if rank < len(hosts) else "localhost"
         if _is_local(host):
-            if _alive(pid):
+            if _alive(pid) and _is_singa_main(pid):
                 os.kill(pid, signal.SIGTERM)
                 print(f"rank {rank}: SIGTERM pid {pid}")
+            elif _alive(pid):
+                print(
+                    f"rank {rank}: pid {pid} is not a singa_tpu.main "
+                    "process (recycled pid?) — leaving it alone"
+                )
             else:
                 print(f"rank {rank}: pid {pid} already gone")
         else:
@@ -193,10 +227,11 @@ def stop(args) -> int:
     # not shared) have no local record — sweep them the run.sh way
     # ("killall -q singa", run.sh:42-45)
     recorded = set(pids)
+    pat = _stop_scope_pattern(args)
     for rank, host in enumerate(hosts):
         if rank not in recorded and not _is_local(host):
-            _ssh(host, "pkill -f singa_tpu.main 2>/dev/null || true")
-            print(f"{host}: pkill -f singa_tpu.main (no local pid record)")
+            _ssh(host, f"pkill -f '{pat}' 2>/dev/null || true")
+            print(f"{host}: pkill -f '{pat}' (no local pid record)")
     return 0
 
 

@@ -133,6 +133,9 @@ class ReplicaTrainer(Trainer):
             lambda *xs: jnp.stack(xs),
             *[init_params(k, self.specs) for k in keys],
         )
+        # uneven kLayerPartition dims: stored arrays pad-to-multiple
+        # (trainer.py _pad_one pads trailing dims under the replica axis)
+        stacked = {n: self._pad_one(n, v) for n, v in stacked.items()}
         self.params = {
             n: jax.device_put(v, self._rep_param_sh[n])
             for n, v in stacked.items()
@@ -140,7 +143,7 @@ class ReplicaTrainer(Trainer):
         # per-replica updater slots through the updater's own init contract
         # (fresh state per replica = the single-replica init, replicated)
         state0 = self.updater.init_state(
-            {n: v[0] for n, v in stacked.items()}
+            {n: v[0] for n, v in stacked.items()}  # already padded
         )
         self.state = {
             n: {
@@ -215,8 +218,14 @@ class ReplicaTrainer(Trainer):
 
             return jax.jit(fn)
 
+        # ratio is fixed once bootstrap ran (_build_sync is lazy), so
+        # full coverage is a static property of the compiled sync
+        full = self.sample_ratio >= 1.0
+
         def fn(replicas, snapshots, center, indices):
-            return random_sync(replicas, snapshots, center, indices)
+            return random_sync(
+                replicas, snapshots, center, indices, full_coverage=full
+            )
 
         return jax.jit(fn)
 
@@ -350,8 +359,18 @@ class ReplicaTrainer(Trainer):
             self.params, self.center = self._sync_jit(
                 self.params, self.center
             )
+        elif self.sample_ratio >= 1.0:
+            # full coverage: random_sync's dense path never reads the
+            # indices — don't materialize/ship R*n int32 per param
+            self.params, self.snapshot, self.center = self._sync_jit(
+                self.params, self.snapshot, self.center, None
+            )
         else:
-            shapes = {n: s.shape for n, s in self.specs.items()}
+            # STORED shapes, not spec shapes: padded params ravel with
+            # different flat offsets, and sampling over the stored
+            # coordinate space keeps the index<->value mapping exact
+            # (tail coordinates carry zero deltas — harmless)
+            shapes = {n: v.shape[1:] for n, v in self.params.items()}
             indices = sample_sync_indices(
                 self._sync_rng, shapes, self.nreplicas, self.sample_ratio
             )
@@ -376,14 +395,23 @@ class ReplicaTrainer(Trainer):
         if path is not None and self.center is not None:
             from .checkpoint import save_checkpoint
 
-            server = dict(self.center)
+            # server-side trees store LOGICAL shapes like the base npz
+            # format (resume re-pads for its mesh)
+            server = {
+                n: self._unpad_one(n, v) for n, v in self.center.items()
+            }
             server["__sample_ratio__"] = jnp.float32(self.sample_ratio)
-            save_checkpoint(
-                path + ".server",
-                step,
-                server,
-                {"__snapshot__": self.snapshot} if self.snapshot else None,
+            snap = (
+                {
+                    "__snapshot__": {
+                        n: self._unpad_one(n, v)
+                        for n, v in self.snapshot.items()
+                    }
+                }
+                if self.snapshot
+                else None
             )
+            save_checkpoint(path + ".server", step, server, snap)
         return path
 
     def _resume(self, path: str) -> None:
@@ -438,9 +466,16 @@ class ReplicaTrainer(Trainer):
                 }
                 self._resume_streams = dict(ck.streams)
         else:
+            # npz checkpoints hold LOGICAL arrays: overlay against the
+            # unpadded views, re-pad below at placement
             step, params, state, buffers = restore_into(
-                path, self.params, self.state, self.buffers
+                path,
+                self._unpad_stored(self.params),
+                self._unpad_state(self.state),
+                self.buffers,
             )
+            params = self._pad_stored(params)
+            state = self._pad_state(state)
             # stream positions: consumed by the base __init__ when it
             # builds the pipelines, same as the sync trainer's resume path
             self._resume_streams = load_stream_positions(path)
@@ -478,13 +513,17 @@ class ReplicaTrainer(Trainer):
                         f"!= model shape {self.specs[n].shape}"
                     )
             self.center = {
-                n: jax.device_put(v, repl) for n, v in sv_params.items()
+                n: jax.device_put(self._pad_one(n, jnp.asarray(v)), repl)
+                for n, v in sv_params.items()
             }
             snap = sv_state.get("__snapshot__")
             if self.protocol == "RandomSync":
                 if snap:
                     self.snapshot = {
-                        n: jax.device_put(v, self._rep_param_sh[n])
+                        n: jax.device_put(
+                            self._pad_one(n, jnp.asarray(v)),
+                            self._rep_param_sh[n],
+                        )
                         for n, v in snap.items()
                     }
                 else:

@@ -32,6 +32,7 @@ from ..optim import make_updater
 from ..parallel import (
     batch_shardings,
     mesh_from_cluster,
+    param_paddings,
     param_shardings,
     replicated,
     state_shardings,
@@ -115,6 +116,16 @@ class Trainer:
                 net.pipeline_mesh = self.mesh
         self.param_sh = param_shardings(self.mesh, self.train_net)
         self.state_sh = state_shardings(self.param_sh, self.updater.SLOTS)
+        #: pad-to-multiple storage for indivisible kLayerPartition dims
+        #: (the reference's uneven-partition contract, neuralnet.cc:160-162
+        #: — see parallel/shardings.py). Nets slice back to logical shapes
+        #: inside forward.
+        self.param_pad = param_paddings(self.mesh, self.train_net)
+        if self.param_pad:
+            logical = {n: self.specs[n].shape for n in self.param_pad}
+            for net in (self.train_net, self.test_net, self.val_net):
+                if net is not None:
+                    net.param_logical = logical
         self.batch_sh = batch_shardings(self.mesh, self.train_net)
         self._repl = replicated(self.mesh)
 
@@ -218,9 +229,15 @@ class Trainer:
         #: stream positions waiting to be applied once pipelines exist
         self._resume_streams: dict[str, int] = {}
         if self.cfg.checkpoint and is_sharded_checkpoint(self.cfg.checkpoint):
-            self._restore_sharded(params, state, buffers)
+            # sharded checkpoints hold STORED (padded) arrays; pad the
+            # fresh-init fallbacks so every entry matches its sharding
+            self._restore_sharded(
+                self._pad_stored(params), self._pad_state(state), buffers
+            )
             return
         if self.cfg.checkpoint:
+            # npz checkpoints hold LOGICAL arrays (save unpads): overlay
+            # first, pad after
             ck_step, params, state, buffers = restore_into(
                 self.cfg.checkpoint, params, state, buffers
             )
@@ -229,6 +246,8 @@ class Trainer:
             self.log(
                 f"resumed from {self.cfg.checkpoint} at step {self.start_step}"
             )
+        params = self._pad_stored(params)
+        state = self._pad_state(state)
         self.params = {
             n: jax.device_put(v, self.param_sh[n]) for n, v in params.items()
         }
@@ -241,6 +260,56 @@ class Trainer:
         }
         self.buffers = {
             n: jax.device_put(v, self._repl) for n, v in buffers.items()
+        }
+
+    # ------------------------------------------------------------------
+    # pad-to-multiple storage (uneven kLayerPartition dims)
+    # ------------------------------------------------------------------
+
+    def _pad_one(self, name: str, arr):
+        """Logical -> stored array: zero-pad the dims param_paddings
+        marked so every shard is even (the zero tail is invisible —
+        Net.forward slices it off, its gradients are structurally zero,
+        and save() strips it). Pad widths apply to the TRAILING dims, so
+        replica-stacked (R, ...) arrays pad correctly too."""
+        w = self.param_pad.get(name)
+        if not w:
+            return arr
+        widths = ((0, 0),) * (arr.ndim - len(w)) + tuple(w)
+        return jnp.pad(arr, widths)
+
+    def _pad_stored(self, params: dict) -> dict:
+        if not self.param_pad:
+            return params
+        return {n: self._pad_one(n, v) for n, v in params.items()}
+
+    def _pad_state(self, state: dict) -> dict:
+        if not self.param_pad:
+            return state
+        return {
+            n: {s: self._pad_one(n, v) for s, v in slots.items()}
+            for n, slots in state.items()
+        }
+
+    def _unpad_one(self, name: str, arr):
+        """Stored -> logical (trailing-dims slice keeps any leading
+        replica axis)."""
+        if name not in self.param_pad:
+            return arr
+        logical = self.specs[name].shape
+        return arr[(Ellipsis, *(slice(0, s) for s in logical))]
+
+    def _unpad_stored(self, params: dict) -> dict:
+        if not self.param_pad:
+            return params
+        return {n: self._unpad_one(n, v) for n, v in params.items()}
+
+    def _unpad_state(self, state: dict) -> dict:
+        if not self.param_pad:
+            return state
+        return {
+            n: {s: self._unpad_one(n, v) for s, v in slots.items()}
+            for n, slots in state.items()
         }
 
     def _restore_sharded(self, params, state, buffers) -> None:
@@ -258,11 +327,38 @@ class Trainer:
         with ShardedCheckpoint(self.cfg.checkpoint) as ck:
             have = set(ck.keys())
 
-            def restore(key, init_val, sharding):
+            def restore(key, init_val, sharding, pname=None):
                 if key not in have:
                     return jax.device_put(init_val, sharding)
                 saved = tuple(ck.manifest["arrays"][key]["shape"])
-                if saved != tuple(init_val.shape):
+                expect = tuple(init_val.shape)
+                if saved != expect:
+                    # uneven-partition storage is mesh-dependent: a
+                    # checkpoint written on a different model-axis width
+                    # padded this param differently. Normalize through
+                    # the logical shape (slice the saved tail, re-pad
+                    # for THIS mesh) via host assembly.
+                    logical = (
+                        self.specs[pname].shape
+                        if pname is not None and pname in self.specs
+                        else None
+                    )
+                    lead = len(expect) - len(logical) if logical else 0
+                    if (
+                        logical is not None
+                        and len(saved) == len(expect)
+                        and saved[:lead] == expect[:lead]
+                        and all(
+                            s >= l for s, l in zip(saved[lead:], logical)
+                        )
+                    ):
+                        arr = ck.assemble(key)[
+                            (Ellipsis, *(slice(0, l) for l in logical))
+                        ]
+                        arr = self._pad_one(pname, jnp.asarray(arr))
+                        return jax.device_put(
+                            arr.astype(init_val.dtype), sharding
+                        )
                     raise ValueError(
                         f"checkpoint {self.cfg.checkpoint!r}: {key!r} "
                         f"shape {saved} != model shape {init_val.shape}"
@@ -273,12 +369,14 @@ class Trainer:
                 return ck.place(key, sharding, dtype=init_val.dtype)
 
             self.params = {
-                n: restore(param_key(n), v, self.param_sh[n])
+                n: restore(param_key(n), v, self.param_sh[n], pname=n)
                 for n, v in params.items()
             }
             self.state = {
                 n: {
-                    s: restore(state_key(n, s), v, self.state_sh[n][s])
+                    s: restore(
+                        state_key(n, s), v, self.state_sh[n][s], pname=n
+                    )
                     for s, v in slots.items()
                 }
                 for n, slots in state.items()
@@ -805,8 +903,14 @@ class Trainer:
             )
         else:
             path = os.path.join(folder, f"step_{step}.npz")
+            # npz checkpoints are host-gathered and mesh-portable: store
+            # LOGICAL shapes (a resume onto a different model-axis width
+            # re-pads for its own mesh)
             save_checkpoint(
-                path, step, self.params, self.state, self.buffers,
+                path, step,
+                self._unpad_stored(self.params),
+                self._unpad_state(self.state),
+                self.buffers,
                 streams=self._stream_positions(),
             )
         self.log(f"step {step}: checkpoint -> {path}")

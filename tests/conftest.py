@@ -98,10 +98,24 @@ def pytest_collection_modifyitems(config, items):
             seen.add(base)
             item.add_marker(pytest.mark.slow)
     # staleness guard: a renamed/removed slow test must fail loudly, not
-    # silently drift back into the fast core signal. Only enforced on
-    # full-directory collection — single-file runs see a subset.
+    # silently drift back into the fast core signal. Enforced whenever
+    # collection was not narrowed by the operator (-k/-m/path args) —
+    # a suite-size threshold would silently lapse if the suite shrank.
+    opt = config.option
+    narrowed = bool(
+        opt.keyword
+        or opt.markexpr
+        or getattr(opt, "ignore", None)
+        or getattr(opt, "ignore_glob", None)
+        or getattr(opt, "deselect", None)
+        or getattr(opt, "lf", False)  # --lf prunes to last-failed files
+        or any(
+            not os.path.isdir(str(a))
+            for a in (config.args or [])
+        )
+    )
     missing = SLOW_TESTS - seen
-    if missing and len(items) > 250:
+    if missing and not narrowed:
         raise pytest.UsageError(
             f"conftest.SLOW_TESTS names not found in collection "
             f"(renamed/removed?): {sorted(missing)}"
