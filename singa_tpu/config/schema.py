@@ -274,6 +274,12 @@ class MoEConfig(Message):
         "d_ff": Field("int", required=True),
         "capacity_factor": Field("float", 1.25),
         "aux_loss_weight": Field("float", 0.01),
+        # "psum" replicates tokens over the expert axis and all-reduces
+        # the combine (exactly dense-equivalent); "alltoall" shards
+        # tokens over the expert axis too and moves only capacity
+        # buffers (GShard semantics: per-shard capacity) —
+        # parallel/moe.py moe_ffn_a2a's comm-volume docstring
+        "dispatch": Field("string", "psum"),
     }
 
 

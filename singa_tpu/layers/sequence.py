@@ -264,6 +264,12 @@ class MoELayer(Layer):
         self.d_ff = p.d_ff
         self.capacity_factor = p.capacity_factor
         self.aux_weight = p.aux_loss_weight
+        self.dispatch = p.dispatch
+        if self.dispatch not in ("psum", "alltoall"):
+            raise ConfigError(
+                f"layer {self.name!r}: moe_param.dispatch must be "
+                f"'psum' or 'alltoall', got {self.dispatch!r}"
+            )
         self.gate = self._declare_param(0, "gate", (d, self.n_experts),
                                         fan_in=d)
         self.up = self._declare_param(
@@ -283,7 +289,7 @@ class MoELayer(Layer):
         return None
 
     def apply(self, params, inputs, *, training, rng=None):
-        from ..parallel.moe import moe_ffn, moe_ffn_dense
+        from ..parallel.moe import moe_ffn, moe_ffn_a2a, moe_ffn_dense
 
         x = inputs[0]
         p = {
@@ -298,6 +304,18 @@ class MoELayer(Layer):
                 raise ConfigError(
                     f"layer {self.name!r}: num_experts {self.n_experts} "
                     f"not divisible by expert axis width {nexp}"
+                )
+            ndata = dict(mesh.shape).get("data", 1)
+            if self.dispatch == "alltoall":
+                if x.shape[0] % (ndata * nexp):
+                    raise ConfigError(
+                        f"layer {self.name!r}: alltoall dispatch shards "
+                        f"the batch over data x expert — batch "
+                        f"{x.shape[0]} must be divisible by "
+                        f"{ndata * nexp}"
+                    )
+                return moe_ffn_a2a(
+                    x, p, mesh, capacity_factor=self.capacity_factor
                 )
             return moe_ffn(
                 x, p, mesh, capacity_factor=self.capacity_factor

@@ -30,6 +30,7 @@ from .moe import (
     init_moe,
     moe_ffn,
     moe_ffn_dense,
+    moe_ffn_a2a,
     moe_param_shardings,
 )
 from .pipeline import build_pp_mesh, pipeline_apply, stage_param_shardings
@@ -54,6 +55,7 @@ __all__ = [
     "init_moe",
     "moe_ffn",
     "moe_ffn_dense",
+    "moe_ffn_a2a",
     "moe_param_shardings",
     "build_pp_mesh",
     "pipeline_apply",

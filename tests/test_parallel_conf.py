@@ -21,7 +21,8 @@ from singa_tpu.trainer import Trainer
 REPO = os.path.join(os.path.dirname(__file__), "..")
 
 
-def _lm_conf(shard, *, attn_mode="dense", moe=False, batch=8):
+def _lm_conf(shard, *, attn_mode="dense", moe=False, batch=8,
+             dispatch="psum"):
     ffn = """
   layer { name: "up" type: "kDense" srclayers: "ln2"
     dense_param { num_output: 64 activation: "gelu" }
@@ -36,12 +37,12 @@ def _lm_conf(shard, *, attn_mode="dense", moe=False, batch=8):
     if moe:
         ffn = """
   layer { name: "moe" type: "kMoE" srclayers: "ln2"
-    moe_param { num_experts: 4 d_ff: 64 aux_loss_weight: 0.01 }
+    moe_param { num_experts: 4 d_ff: 64 aux_loss_weight: 0.01 dispatch: "%s" }
     param { name: "gate" init_method: "kGaussain" std: 0.02 }
     param { name: "up" init_method: "kUniformSqrtFanIn" }
     param { name: "down" init_method: "kUniformSqrtFanIn" } }
   layer { name: "res2" type: "kAdd" srclayers: "res1" srclayers: "moe" }
-"""
+""" % dispatch
     return parse_model_config(f"""
 name: "sp-ep-test"
 train_steps: 4
@@ -160,6 +161,21 @@ def test_moe_conf_expert_parallel_matches_dense(token_shard):
     )
     ep = _train_losses(_lm_conf(token_shard, moe=True), cluster)
     np.testing.assert_allclose(ep, dense, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_conf_alltoall_dispatch_trains(token_shard):
+    """dispatch: "alltoall" from the text-proto surface: tokens shard
+    over data x expert, capacity buffers move by all_to_all, training
+    proceeds (ample capacity at this size keeps it near the psum path)."""
+    cluster = _cluster(
+        "nworkers: 8\nnprocs_per_group: 4\nnexperts_per_group: 4"
+    )
+    losses = _train_losses(
+        _lm_conf(token_shard, moe=True, dispatch="alltoall"),
+        cluster, steps=6,
+    )
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
 
 
 def test_moe_conf_full_dp_ep_mesh_trains(token_shard):
