@@ -793,7 +793,7 @@ class Trainer:
                         fn(eval_params, eval_buffers, self._next_batch(net))
                     )
         avg = perf.avg()
-        self.log(f"step {step}: {phase} {perf.to_string()}")
+        self.log(f"step {step}: {phase} {perf.to_string(avg)}")
         return avg
 
     def _pre_events(self, step: int) -> None:

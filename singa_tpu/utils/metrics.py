@@ -67,10 +67,14 @@ class Performance:
             out.setdefault(l, {})[k] = float(v) / n
         return out
 
-    def to_string(self) -> str:
-        """One-line display like Worker's "loss : 2.301, precision : 0.11"."""
+    def to_string(self, avg: dict | None = None) -> str:
+        """One-line display like Worker's "loss : 2.301, precision : 0.11".
+
+        Pass an already-computed ``avg()`` dict to avoid a second device
+        round trip (the eval path computes avg for its return value and
+        logs in the same breath)."""
         parts = []
-        for lname, bucket in sorted(self.avg().items()):
+        for lname, bucket in sorted((avg or self.avg()).items()):
             inner = ", ".join(f"{k} : {v:.6g}" for k, v in sorted(bucket.items()))
             parts.append(f"{lname} [{inner}]" if len(self._sums) > 1 else inner)
         return ", ".join(parts) if parts else "no metrics"

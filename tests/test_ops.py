@@ -167,6 +167,60 @@ def _ref_pool(x, k, s, mode):
     return out
 
 
+def test_max_pool_grad_matches_mshadow_unpool():
+    """The custom max-pool VJP must give the gradient to EVERY position
+    equal to its window's max — mshadow's unpool semantics
+    (tensor_expr_ext.h:482 `s == maxval`), including ties and
+    overlapping windows — and handle ceil-mode overhang."""
+    rng = np.random.RandomState(3)
+    for h, k, s in [(6, 2, 2), (7, 3, 2), (5, 3, 2)]:
+        x = rng.randint(0, 4, (2, 3, h, h)).astype(np.float32)  # many ties
+        oh = ops.pooled_size(h, k, s)
+        dy = rng.randn(2, 3, oh, oh).astype(np.float32)
+
+        def np_unpool(x, dy):
+            dx = np.zeros_like(x)
+            for oi in range(oh):
+                for oj in range(oh):
+                    wi = x[:, :, oi * s : oi * s + k, oj * s : oj * s + k]
+                    m = wi.max(axis=(2, 3), keepdims=True)
+                    dx[:, :, oi * s : oi * s + k, oj * s : oj * s + k] += (
+                        (wi == m) * dy[:, :, oi : oi + 1, oj : oj + 1]
+                    )
+            return dx
+
+        got = jax.grad(
+            lambda x: jnp.vdot(ops.max_pool2d(x, k, s), jnp.asarray(dy))
+        )(jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(got), np_unpool(x, dy), atol=1e-6,
+            err_msg=f"h={h} k={k} s={s}",
+        )
+
+
+def test_avg_pool_grad_matches_autodiff_of_reference():
+    """The custom avg-pool VJP (phase-decomposed unpool) must equal
+    autodiff of the reduce_window formulation."""
+    from singa_tpu.ops.nn import _pool
+    from jax import lax
+
+    rng = np.random.RandomState(5)
+    for h, k, s in [(6, 2, 2), (7, 3, 2), (5, 3, 2)]:
+        x = jnp.asarray(rng.randn(2, 3, h, h).astype(np.float32))
+        oh = ops.pooled_size(h, k, s)
+        dy = jnp.asarray(rng.randn(2, 3, oh, oh).astype(np.float32))
+        got = jax.grad(lambda x: jnp.vdot(ops.avg_pool2d(x, k, s), dy))(x)
+        want = jax.grad(
+            lambda x: jnp.vdot(
+                _pool(x, k, s, 0.0, lax.add) * (1.0 / (k * k)), dy
+            )
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5,
+            err_msg=f"h={h} k={k} s={s}",
+        )
+
+
 def test_pooling_matches_reference():
     rng = np.random.RandomState(1)
     for h in (6, 7):  # 7 exercises the overhanging ceil-mode window
