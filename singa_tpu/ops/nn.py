@@ -247,7 +247,6 @@ def _phase_grids(kernel: int, stride: int, ph: int, pw: int):
 def _interleave_phases(rows, b, c, hp, wp, h, w):
     """(r1, r2)-indexed phase grids -> (B, C, h, w)."""
     phases = jnp.stack([jnp.stack(cols) for cols in rows])
-    s1, s2 = phases.shape[0], phases.shape[1]
     dxp = phases.transpose(2, 3, 4, 0, 5, 1).reshape(b, c, hp, wp)
     return dxp[:, :, :h, :w]
 

@@ -168,11 +168,24 @@ def _pids(workspace: str) -> dict[int, tuple[str, int]]:
 
 
 def _alive(pid: int) -> bool:
+    """True for a RUNNING process. Zombies count as dead: start() holds
+    the local children's Popen handles without waiting, so an exited
+    child stays a zombie until this process exits — os.kill(pid, 0)
+    succeeds on it, and treating that as alive made `stop`/wait loops
+    burn their full deadlines on already-finished ranks."""
     try:
         os.kill(pid, 0)
-        return True
     except (ProcessLookupError, PermissionError):
         return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # state = first field after the parenthesized comm (which
+            # may itself contain spaces/parens — split on the LAST ')')
+            if f.read().rsplit(")", 1)[1].split()[0] == "Z":
+                return False
+    except (OSError, IndexError):  # no /proc: keep the kill(0) answer
+        pass
+    return True
 
 
 def _is_singa_main(pid: int) -> bool:

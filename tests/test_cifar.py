@@ -164,10 +164,11 @@ class TestAlexNet:
         # batch 64: divisible by the default 8-wide virtual data mesh.
         # lr 0.002 (the conf's 0.001 scale — larger rates diverge and
         # collapse to dead ReLUs on this short run), conv1 std widened
-        # from the conf's 1e-4 so 150 steps suffice.
+        # from the conf's 1e-4 so 100 steps suffice (measured 0.969 at
+        # 100 steps vs the 0.9 bar — same oracle, smaller geometry).
         from singa_tpu.data.loader import write_records
 
-        cfg = _prep_alexnet(tmp_path, train_steps=150, batchsize=64)
+        cfg = _prep_alexnet(tmp_path, train_steps=100, batchsize=64)
         write_records(
             str(tmp_path / "train_shard"),
             *structured_rgb(400, seed=1),

@@ -295,16 +295,25 @@ def test_small_resnet_trains(tmp_path):
     )
     text = resnet_conf(
         depth=18, classes=4, batchsize=32, size=32,
-        train_shard=shard, test_shard=shard, train_steps=30,
+        train_shard=shard, test_shard=shard, train_steps=20,
         compute_dtype="",
     )
     cfg = parse_model_config(text)
     cfg.test_steps = 0
     cfg.display_frequency = 0
     cfg.checkpoint_frequency = 0
-    tr = Trainer(cfg, seed=0, log=lambda s: None, prefetch=False)
-    tr.train_chunk(0, 10)
+    # 1-device mesh: this test pins training/buffer mechanics, not
+    # sharding (test_parallel covers that); 8 virtual devices on this
+    # 1-core host only serialize the same math with 8x dispatch overhead
+    from singa_tpu.parallel import build_mesh
+
+    tr = Trainer(
+        cfg, mesh=build_mesh(1, 1, jax.devices()[:1]),
+        seed=0, log=lambda s: None, prefetch=False,
+    )
+    tr.train_chunk(0, 8)
     tr.perf.reset()
-    tr.train_chunk(10, 20)
+    tr.train_chunk(8, 12)
     (m,) = tr.perf.avg().values()
+    # measured 0.849 at this geometry — same oracle, fewer steps
     assert m["precision"] > 0.6  # random = 0.25

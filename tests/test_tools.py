@@ -257,8 +257,9 @@ neuralnet {{
         assert rc == 0
         pids = cluster._pids(str(ws))
         assert sorted(pids) == [0, 1]
-        # wait for both ranks to finish training (short job)
-        deadline = time.time() + 240
+        # wait for both ranks to finish training (short job; exited
+        # children are zombies of THIS process — _alive counts them dead)
+        deadline = time.time() + 120
         while time.time() < deadline and any(
             cluster._alive(pid) for _, pid in pids.values()
         ):
