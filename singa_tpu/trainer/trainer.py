@@ -893,7 +893,20 @@ class Trainer:
         folder = self._checkpoint_dir()
         if folder is None:
             return None
-        if self.cfg.checkpoint_format == "sharded":
+        # a model axis spanning process boundaries (cross-process
+        # kLayerPartition) leaves params PARTITIONED with shards this
+        # host cannot see: the host-gathering npz writer cannot
+        # materialize them. The per-process sharded format exists for
+        # exactly this topology — auto-upgrade rather than crash at the
+        # end of a training run. Fully-replicated multi-process arrays
+        # are fine for npz (every host holds the whole value), so they
+        # keep the configured format.
+        spans_procs = any(
+            not v.is_fully_addressable
+            and not v.sharding.is_fully_replicated
+            for v in self.params.values()
+        )
+        if self.cfg.checkpoint_format == "sharded" or spans_procs:
             from .sharded_ckpt import save_sharded
 
             path = os.path.join(folder, f"step_{step}.ckpt")

@@ -56,7 +56,18 @@ def run() -> int:
     import jax
 
     t = captured["trainer"]
-    arrays = {n: np.asarray(v) for n, v in t.params.items()}
+    # params may be SHARDED across processes (model axis spanning ranks —
+    # the cross-process bridge analog): allgather to full numpy views.
+    # np.asarray alone raises on non-addressable arrays.
+    from jax.experimental import multihost_utils
+
+    logical = t._unpad_stored(t.params)
+    arrays = {
+        n: np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        if jax.process_count() > 1 and not v.is_fully_addressable
+        else np.asarray(v)
+        for n, v in logical.items()
+    }
     np.savez(out + ".tmp.npz", **arrays)
     os.replace(out + ".tmp.npz", out)
     meta = {
@@ -66,6 +77,10 @@ def run() -> int:
         "global_devices": len(jax.devices()),
         "local_devices": len(jax.local_devices()),
         "batch_shard_ok": _batch_sharded(t),
+        "weight_spec": [
+            None if ax is None else str(ax)
+            for ax in t.params["fc1/w"].sharding.spec
+        ] if "fc1/w" in t.params else None,
     }
     with open(out + ".json", "w") as f:
         json.dump(meta, f)

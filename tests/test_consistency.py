@@ -15,7 +15,7 @@ import pytest
 from singa_tpu.config import parse_cluster_config
 from singa_tpu.config.schema import ConfigError
 from singa_tpu.data.loader import synthetic_arrays
-from singa_tpu.parallel import build_mesh
+from singa_tpu.parallel import MODEL_AXIS, build_mesh
 from singa_tpu.parallel.consistency import (
     elastic_sync,
     random_sync,
@@ -385,6 +385,78 @@ class TestReplicaTrainer:
             prefetch=False,
         )
         assert isinstance(t2, Trainer) and not isinstance(t2, ReplicaTrainer)
+
+
+class TestReplicaComposition:
+    """Replica protocols x kLayerPartition (VERDICT r4 #1a): the reference
+    composes intra-group model partitioning with cross-group async sync
+    freely (group_size>1 partitions the net, src/worker/neuralnet.cc:55-56,
+    while Elastic/RandomSync reconcile the groups, src/utils/param.cc:
+    216-256). Here that composition is the (replica, model) mesh branch of
+    trainer/replica.py (_rep_param_sh prepends DATA_AXIS to each param's
+    kLayerPartition spec). Oracle: a (4 replicas x 2-way model) mesh must
+    reproduce the (4 replicas x 1) trajectory exactly — partitioning is a
+    layout choice, the protocol math must not notice it."""
+
+    def _run(self, tmp_path, mesh, protocol, **sync_kw):
+        cfg = _set_sync(
+            _replica_conf(tmp_path, train_steps=12, lr=0.1),
+            protocol, **sync_kw,
+        )
+        cfg.neuralnet.partition_type = "kLayerPartition"
+        cluster = parse_cluster_config(
+            'nworkers: 8 nservers: 1 workspace: "%s" bandwidth: 1e9'
+            % str(tmp_path / "ws")
+        )
+        t = ReplicaTrainer(
+            cfg, cluster, mesh=mesh, seed=5, log=lambda s: None,
+            prefetch=False,
+        )
+        t.run()
+        return t
+
+    def _assert_same(self, t_a, t_b):
+        for n in t_a.params:
+            np.testing.assert_allclose(
+                np.asarray(t_a._unpad_stored(t_a.params)[n]),
+                np.asarray(t_b._unpad_stored(t_b.params)[n]),
+                rtol=2e-4, atol=1e-5, err_msg=f"param {n} diverged",
+            )
+        for n in t_a.center:
+            np.testing.assert_allclose(
+                np.asarray(t_a._unpad_one(n, t_a.center[n])),
+                np.asarray(t_b._unpad_one(n, t_b.center[n])),
+                rtol=2e-4, atol=1e-5, err_msg=f"center {n} diverged",
+            )
+
+    def test_elastic_on_replica_x_model_mesh(self, tmp_path):
+        t41 = self._run(
+            tmp_path / "e41", build_mesh(4, 1), "Elastic",
+            moving_rate=0.3, sync_frequency=2, warmup=4,
+        )
+        t42 = self._run(
+            tmp_path / "e42", build_mesh(4, 2), "Elastic",
+            moving_rate=0.3, sync_frequency=2, warmup=4,
+        )
+        # the model-axis branch actually executed: params carry a real
+        # (replica, ..., model) sharding, not full replication
+        w = t42.params["fc1/weight"]
+        assert MODEL_AXIS in jax.tree.leaves(
+            [ax for ax in w.sharding.spec if ax is not None]
+        )
+        self._assert_same(t41, t42)
+
+    def test_random_sync_on_replica_x_model_mesh(self, tmp_path):
+        t41 = self._run(
+            tmp_path / "r41", build_mesh(4, 1), "RandomSync",
+            moving_rate=0.0, sync_frequency=2, warmup=4,
+        )
+        t42 = self._run(
+            tmp_path / "r42", build_mesh(4, 2), "RandomSync",
+            moving_rate=0.0, sync_frequency=2, warmup=4,
+        )
+        assert t41.sample_ratio == 1.0 and t42.sample_ratio == 1.0
+        self._assert_same(t41, t42)
 
 
 class TestReplicaProductionEngine:
