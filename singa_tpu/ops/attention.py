@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -469,14 +470,21 @@ def _flash_bwd_dkv_staged(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+#: VMEM staging budget, read ONCE at import: _variant runs at trace
+#: time from both the fwd and bwd custom-vjp halves, and jit caches are
+#: not keyed on env vars — a mid-process change would leave stale
+#: compilations (or mismatched fwd/bwd variants). Fixing it per process
+#: keeps variant selection stable.
+_FLASH_STAGE_BYTES = (
+    float(os.environ.get("SINGA_TPU_FLASH_STAGE_MB", "8")) * 1e6
+)
+
+
 def _variant(s: int, d: int, dtype) -> str:
     """'staged' while K+V for one head row fit the VMEM budget
-    (SINGA_TPU_FLASH_STAGE_MB, default 8), else 'streamed'."""
-    import os
-
-    budget = float(os.environ.get("SINGA_TPU_FLASH_STAGE_MB", "8")) * 1e6
+    (SINGA_TPU_FLASH_STAGE_MB, import-time), else 'streamed'."""
     kv_bytes = 2 * s * d * jnp.dtype(dtype).itemsize
-    return "staged" if kv_bytes <= budget else "streamed"
+    return "staged" if kv_bytes <= _FLASH_STAGE_BYTES else "streamed"
 
 
 def _auto_block(s: int) -> int:

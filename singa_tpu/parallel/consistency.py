@@ -34,9 +34,20 @@ Protocols (semantics pinned by tests/test_consistency.py):
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+#: partial-coverage dense-prefix budget, in ELEMENTS of the (R, n) delta
+#: field (fp32 => x4 bytes). Above this, random_sync uses the serial-scan
+#: formulation whose peak transient is the (R, m) sampled field itself —
+#: the dense field is never built. Read ONCE at import (a trace-time env
+#: read would leave stale jit caches when the var changes mid-process).
+DENSE_PREFIX_MAX_ELEMS = int(
+    os.environ.get("SINGA_TPU_RS_DENSE_ELEMS", 64 * 1024 * 1024)
+)
 
 
 def sync_now(step: int, sync_frequency: int, warmup_steps: int) -> bool:
@@ -124,6 +135,19 @@ def random_sync(replicas, snapshots, center, indices, full_coverage=False):
     argument (the only caller, trainer/replica.py, derives it from the
     static sample_ratio).
 
+    **Memory bound (r5):** the partial-coverage dense path materializes
+    an (R, n) delta field — at the flagship's 18.8M params x 8 replicas
+    a ~600 MB fp32 transient. When R*n exceeds DENSE_PREFIX_MAX_ELEMS
+    (default 64M elements = 256 MB fp32; SINGA_TPU_RS_DENSE_ELEMS, read
+    once at import) the round instead runs the serial-scan formulation
+    — the reference's own per-replica server loop — whose peak
+    transient is the (R, m) sampled field plus one O(n) carry: the
+    dense field is never built. Both compute identical values (scan ==
+    prefix by associativity; the oracle test covers each). At the
+    protocol's real operating point (small ratio, param.cc:148) the
+    scan also does strictly less work: O(R*m) touched coordinates vs
+    the prefix's O(R*n) cumsum.
+
     Returns (replicas, snapshots, center).
     """
     new_r, new_s, new_c = {}, {}, {}
@@ -140,7 +164,8 @@ def random_sync(replicas, snapshots, center, indices, full_coverage=False):
             new_vals = c0[None, :] + prefix
             new_r[name] = new_vals.reshape(shape)
             new_s[name] = new_vals.reshape(shape)
-        else:
+            new_c[name] = (c0 + prefix[-1]).reshape(center[name].shape)
+        elif R * n <= DENSE_PREFIX_MAX_ELEMS:
             ix = indices[name]
             delta = (
                 jnp.take_along_axis(w, ix, 1)
@@ -158,8 +183,33 @@ def random_sync(replicas, snapshots, center, indices, full_coverage=False):
             new_s[name] = jax.vmap(
                 lambda row, i, v: row.at[i].set(v)
             )(snap, ix, upd).reshape(shape)
-        new_c[name] = (c0 + prefix[-1]).reshape(center[name].shape)
+            new_c[name] = (c0 + prefix[-1]).reshape(center[name].shape)
+        else:
+            wi, si, c = _scan_random_sync(w, snap, c0, indices[name])
+            new_r[name] = wi.reshape(shape)
+            new_s[name] = si.reshape(shape)
+            new_c[name] = c.reshape(center[name].shape)
     return new_r, new_s, new_c
+
+
+def _scan_random_sync(w, snap, c0, ix):
+    """The serial server loop, verbatim: replica i's sampled deltas hit
+    the center before replica i+1's message is handled (per-param lock,
+    server.cc:110-143). Peak transient memory is the (R, m) gathered
+    field — used by random_sync when the dense (R, n) prefix field
+    would exceed DENSE_PREFIX_MAX_ELEMS."""
+
+    def step(c, inp):
+        wi, si, ixi = inp
+        delta = wi[ixi] - si[ixi]
+        new = c[ixi] + delta  # server's pre-update value + own delta
+        c = c.at[ixi].add(delta)
+        wi = wi.at[ixi].set(new)
+        si = si.at[ixi].set(new)
+        return c, (wi, si)
+
+    c, (w2, s2) = jax.lax.scan(step, c0, (w, snap, ix))
+    return w2, s2, c
 
 
 def sample_sync_indices(

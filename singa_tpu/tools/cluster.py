@@ -243,8 +243,12 @@ def stop(args) -> int:
     pat = _stop_scope_pattern(args)
     for rank, host in enumerate(hosts):
         if rank not in recorded and not _is_local(host):
-            _ssh(host, f"pkill -f '{pat}' 2>/dev/null || true")
-            print(f"{host}: pkill -f '{pat}' (no local pid record)")
+            # shlex.quote, not manual single quotes: re.escape protects
+            # the regex but a workspace/conf path containing a quote
+            # would break the remote shell string (and the alternation
+            # would silently match nothing)
+            _ssh(host, f"pkill -f {shlex.quote(pat)} 2>/dev/null || true")
+            print(f"{host}: pkill -f {shlex.quote(pat)} (no local pid record)")
     return 0
 
 

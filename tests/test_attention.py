@@ -84,7 +84,11 @@ class TestFlashKernel:
         """Force the HBM-streaming kernels (the long-context path that
         staged K/V cannot serve) and pin values AND all three grads
         against the dense reference."""
-        monkeypatch.setenv("SINGA_TPU_FLASH_STAGE_MB", "0")
+        # the staging budget is frozen at import (jit caches are not
+        # keyed on env vars) — patch the module global, not the env
+        from singa_tpu.ops import attention as attn_mod
+
+        monkeypatch.setattr(attn_mod, "_FLASH_STAGE_BYTES", 0.0)
         q, k, v = qkv((1, 2, 256, 32))
         g = jnp.asarray(
             np.random.RandomState(11).randn(1, 2, 256, 32).astype(np.float32)

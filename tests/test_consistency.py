@@ -122,7 +122,17 @@ class TestElastic:
 
 
 class TestRandomSync:
-    def test_matches_numpy_oracle(self):
+    @pytest.mark.parametrize("dense_budget", [None, 0])
+    def test_matches_numpy_oracle(self, dense_budget, monkeypatch):
+        """Both partial-coverage formulations — the dense parallel
+        prefix and the bounded-memory serial scan (budget 0 forces it)
+        — match the straight-line transcription of the wire protocol."""
+        if dense_budget is not None:
+            from singa_tpu.parallel import consistency
+
+            monkeypatch.setattr(
+                consistency, "DENSE_PREFIX_MAX_ELEMS", dense_budget
+            )
         reps, center, shapes = _rand_trees(R=3, seed=1)
         snaps = {
             k: v + np.random.RandomState(9).randn(*v.shape).astype(np.float32)
