@@ -237,7 +237,8 @@ def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
     )
 
 
-def bench_resnet50(n1=20, n2=60, batch=128):
+def bench_resnet50(n1=20, n2=60, batch=128, stats_stride=0,
+                   name="resnet50"):
     # window sizes: at ~46ms/step, 6/18-step windows left the slope
     # exposed to ±2ms of tunnel jitter; 20/60 brings repeatability to
     # ~±0.2ms (r4 A/B measurements)
@@ -257,8 +258,22 @@ def bench_resnet50(n1=20, n2=60, batch=128):
             layer.data_param.path = shard
             layer.data_param.batchsize = batch
             layer.data_param.random_skip = 0
+        if stats_stride and layer.type == "kBatchNorm":
+            layer.batchnorm_param.stats_sample_stride = stats_stride
     _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
-    return _run_workload("resnet50", cfg, n1, n2)
+    return _run_workload(name, cfg, n1, n2)
+
+
+def bench_resnet50_fastbn(n1=20, n2=60, batch=128):
+    """ResNet-50 with the OPT-IN subsample-stats BN knob (stride 4:
+    stats from 32 of 128 samples, straight-through backward —
+    batchnorm_param.stats_sample_stride, different math, default off).
+    Exists because the same-math ceiling is measured at ~34.7% MFU:
+    the stats read is the only fusion-recoverable term and it is worth
+    at most 3.3 ms (bench/ablations/bn_roofline.py, BASELINE.md r5)."""
+    return bench_resnet50(
+        n1, n2, batch, stats_stride=4, name="resnet50_fastbn"
+    )
 
 
 def bench_lm_longctx(n1=64, n2=256):
@@ -309,6 +324,7 @@ BENCHES = (
     ("lm_longctx", bench_lm_longctx),
     ("lm_32k", bench_lm_32k),
     ("resnet50", bench_resnet50),
+    ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),
 )
 

@@ -297,6 +297,10 @@ class BatchNormConfig(Message):
     FIELDS = {
         "momentum": Field("float", 0.9),
         "eps": Field("float", 1e-5),
+        # OPT-IN different math (r5): batch moments from the first
+        # batch/N sample rows with a straight-through (detached-stats)
+        # backward — see ops/norm.py batch_norm_train_sampled. 1 = exact.
+        "stats_sample_stride": Field("int", 1),
     }
 
 

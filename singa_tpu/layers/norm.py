@@ -40,6 +40,22 @@ class BatchNormLayer(Layer):
         p = self.cfg.batchnorm_param
         self.momentum = p.momentum if p else 0.9
         self.eps = p.eps if p else 1e-5
+        self.stats_stride = p.stats_sample_stride if p else 1
+        if self.stats_stride < 1:
+            raise ConfigError(
+                f"layer {self.name!r}: stats_sample_stride must be >= 1"
+            )
+        # leave at least 8 sample rows in the stats subsample: a stride
+        # that reduces stats to 1-2 rows drives per-channel variance
+        # toward 0 and inv toward rsqrt(eps) ~ 316 — silent divergence,
+        # not a perf knob
+        if self.stats_stride > 1 and batchsize // self.stats_stride < 8:
+            raise ConfigError(
+                f"layer {self.name!r}: stats_sample_stride "
+                f"{self.stats_stride} leaves "
+                f"{max(batchsize // self.stats_stride, 0)} of {batchsize} "
+                "sample rows for the batch moments (need >= 8)"
+            )
         src = require_one_src(self, src_shapes)
         if len(src) not in (2, 4):
             raise ConfigError(
@@ -60,18 +76,31 @@ class BatchNormLayer(Layer):
 
         x = inputs[0]
         if training:
-            # fused one-pass BN (ops/norm.py custom VJP — stats in fp32,
-            # minimal HBM traffic; 18ms -> see BASELINE.md r4 ablation)
-            y, mean, var = ops.batch_norm_train(
-                x,
-                params[self.gname],
-                params[self.bname],
-                self.eps,
-                # running mean anchors the one-pass moments: a free
-                # independent input (an anchor computed from x costs
-                # ~2.5ms/step on ResNet-50 — ops/norm.py docstring)
-                shift=jax.lax.stop_gradient(buffers[self.mean_buf]),
-            )
+            # running mean anchors the one-pass moments: a free
+            # independent input (an anchor computed from x costs
+            # ~2.5ms/step on ResNet-50 — ops/norm.py docstring)
+            anchor = jax.lax.stop_gradient(buffers[self.mean_buf])
+            if self.stats_stride > 1:
+                # OPT-IN subsample-stats + straight-through backward
+                # (different math; ops/norm.py batch_norm_train_sampled)
+                y, mean, var = ops.batch_norm_train_sampled(
+                    x,
+                    params[self.gname],
+                    params[self.bname],
+                    self.eps,
+                    self.stats_stride,
+                    shift=anchor,
+                )
+            else:
+                # fused one-pass BN (ops/norm.py custom VJP — stats in
+                # fp32, minimal HBM traffic; BASELINE.md r4 ablation)
+                y, mean, var = ops.batch_norm_train(
+                    x,
+                    params[self.gname],
+                    params[self.bname],
+                    self.eps,
+                    shift=anchor,
+                )
             # running stats are a detached side effect
             mean = jax.lax.stop_gradient(mean)
             var = jax.lax.stop_gradient(var)

@@ -26,7 +26,11 @@ from .nn import (
     pooled_size,
     softmax_loss,
 )
-from .norm import batch_norm_infer, batch_norm_train
+from .norm import (
+    batch_norm_infer,
+    batch_norm_train,
+    batch_norm_train_sampled,
+)
 
 __all__ = [
     "bnll",
@@ -45,4 +49,5 @@ __all__ = [
     "softmax_loss",
     "batch_norm_infer",
     "batch_norm_train",
+    "batch_norm_train_sampled",
 ]

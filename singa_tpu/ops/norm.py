@@ -163,6 +163,84 @@ def _bn_bwd(eps, res, cts):
 batch_norm_train.defvjp(_bn_fwd, _bn_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def batch_norm_train_sampled(x, gamma, beta, eps, stride, shift=None):
+    """Subsample-stats BatchNorm (OPT-IN, different math — r5 knob).
+
+    Batch moments are computed from the first ``batch/stride`` sample
+    rows (a contiguous prefix — see _apply_sampled for why not a
+    strided slice) and the backward treats them as DETACHED constants:
+
+        dx = gamma * inv * dy          (no reduction dependency)
+        dgamma/dbeta exact as usual
+
+    Two deliberate approximations vs batch_norm_train:
+      * stats see batch/stride samples (an unbiased but noisier moment
+        estimate — large batches tolerate this the way ghost/virtual BN
+        does);
+      * the mean/var gradient paths are dropped (straight-through).
+    Why it exists: measured on ResNet-50 @128/v5e, exact BN's marginal
+    cost is 14.6 ms of a 46.6 ms step, and the irreducible same-math
+    term (the stats read, 2.71 GB) caps a perfect conv-epilogue kernel
+    at ~3.3 ms back = 34.7% MFU (bench/ablations/bn_roofline.py). This
+    knob removes (stride-1)/stride of the stats read AND lets XLA fuse
+    the whole backward into one (dy, x) read since dx no longer waits
+    on the reductions. Exposed as batchnorm_param.stats_sample_stride
+    (default 1 = exact op); convergence consequences are the user's
+    opt-in.
+
+    Returns (y, mean, var) like batch_norm_train.
+    """
+    y, mean, var, _ = _apply_sampled(x, gamma, beta, eps, stride, shift)
+    return y, mean, var
+
+
+def _apply_sampled(x, gamma, beta, eps, stride, shift):
+    axes, shape = _axes_shape(x)
+    # contiguous PREFIX rows, not a strided slice: x[::stride] lowers to
+    # a gather/copy on TPU (measured: the stride-4 knob ran 9 ms SLOWER
+    # than exact BN with it), while x[:n/stride] is a zero-cost view.
+    # Batches are shuffled streams, so a prefix is as unbiased a sample
+    # as a stride.
+    nkeep = max(1, x.shape[0] // stride)
+    xs = jax.lax.slice_in_dim(x, 0, nkeep, 1, axis=0)
+    n = xs.size // xs.shape[1]
+    mean, var = _moments(xs, axes, shape, n, shift)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    sh = beta.astype(jnp.float32) - scale * mean
+    y = (
+        x * scale.astype(x.dtype).reshape(shape)
+        + sh.astype(x.dtype).reshape(shape)
+    )
+    return y, mean, var, inv
+
+
+def _bns_fwd(x, gamma, beta, eps, stride, shift):
+    y, mean, var, inv = _apply_sampled(x, gamma, beta, eps, stride, shift)
+    return (y, mean, var), (x, gamma, beta, mean, inv, shift)
+
+
+def _bns_bwd(eps, stride, res, cts):
+    dy, _dmean, _dvar = cts  # stats are detached: their cotangents drop
+    x, gamma, beta, mean, inv, shift = res
+    axes, shape = _axes_shape(x)
+    dyf = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    dbeta = jnp.sum(dyf, axes)
+    dgamma = jnp.sum(dyf * xhat, axes)
+    # straight-through: dx independent of the reductions — one fused
+    # (dy, x) read produces dx AND both param grads
+    dx = (
+        dyf * (gamma.astype(jnp.float32) * inv).reshape(shape)
+    ).astype(x.dtype)
+    dshift = None if shift is None else jnp.zeros_like(shift)
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype), dshift
+
+
+batch_norm_train_sampled.defvjp(_bns_fwd, _bns_bwd)
+
+
 def batch_norm_infer(x, gamma, beta, mean, var, eps=1e-5):
     """Normalize by running stats (eval path); plain autodiff is fine
     here — stats are constants, so it's one fused elementwise pass."""
