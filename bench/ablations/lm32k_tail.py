@@ -1,0 +1,113 @@
+"""lm_32k phase attribution: where the model-vs-kernel MFU gap lives.
+
+VERDICT r4 #7: lm_32k model-level MFU (21.6%) trails the streamed
+kernel's standalone 49.1 TF/s (24.9% of peak) with no accounting of the
+non-attention tail. This harness produces the same three-way split the
+S=8192 regime got:
+
+  1. full tinylm step at S=32768, batch 1 (bench.py lm_32k methodology);
+  2. the same step with BOTH attention layers monkeypatched to identity
+     -> the non-attention tail's direct time;
+  3. the flash kernel standalone at the model's exact shape
+     (batch 1, 4 heads, d=64, S=32768, causal, fwd+bwd x2 blocks).
+
+Run (reserves the chip):  python bench/ablations/lm32k_tail.py
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+
+def model_step_ms(identity_attn: bool) -> float:
+    """bench.py lm_32k two-window slope, optionally with attention
+    layers passing their input through (params still exist; the QKV/out
+    projections vanish with the scores — the measured tail is the
+    embed/LN/FFN/head/loss remainder)."""
+    import bench
+    from singa_tpu.layers import sequence as seq_mod
+
+    orig = seq_mod.AttentionLayer.apply
+    if identity_attn:
+        seq_mod.AttentionLayer.apply = (
+            lambda self, params, inputs, *, training, rng=None: inputs[0]
+        )
+    try:
+        w = bench.bench_lm_32k()
+    finally:
+        seq_mod.AttentionLayer.apply = orig
+    return w["step_ms"]
+
+
+def kernel_ms(s=32768, heads=4, d=64, nblocks=2) -> float:
+    """Standalone flash f+b at the model's shape, scan-slope."""
+    from singa_tpu.ops.attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kd = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (1, heads, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, heads, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, heads, s, d), jnp.bfloat16)
+    dy = jax.random.normal(kd, (1, heads, s, d), jnp.bfloat16)
+
+    def one(args):
+        q, k, v = args
+
+        def f(q, k, v):
+            out = q
+            for _ in range(nblocks):
+                out = flash_attention(out, k, v, True)
+            return jnp.vdot(out.astype(jnp.float32), dy.astype(jnp.float32))
+
+        val, (dq, dk, dv) = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return (
+            q + dq.astype(q.dtype) * jnp.bfloat16(1e-6),
+            k + dk.astype(k.dtype) * jnp.bfloat16(1e-6),
+            v + dv.astype(v.dtype) * jnp.bfloat16(1e-6),
+        )
+
+    def loop(args, n):
+        def body(c, _):
+            return one(c), None
+
+        out, _ = jax.lax.scan(body, args, None, length=n)
+        return out
+
+    n1, n2 = 4, 12
+    j1 = jax.jit(lambda a: loop(a, n1))
+    j2 = jax.jit(lambda a: loop(a, n2))
+    args = (q, k, v)
+    jax.block_until_ready(j1(args))
+    jax.block_until_ready(j2(args))
+    best = {}
+    for name, j in (("n1", j1), ("n2", j2)):
+        best[name] = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(j(args))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    return (best["n2"] - best["n1"]) / (n2 - n1) * 1e3
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}")
+    full = model_step_ms(identity_attn=False)
+    tail = model_step_ms(identity_attn=True)
+    kern = kernel_ms()
+    print(f"full lm_32k step:            {full:7.2f} ms")
+    print(f"attention->identity (tail):  {tail:7.2f} ms")
+    print(f"implied in-model attention:  {full - tail:7.2f} ms")
+    print(f"standalone kernel (2 calls): {kern:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
