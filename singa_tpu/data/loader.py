@@ -179,15 +179,35 @@ def synthetic_arrays(
 
 
 def structured_rgb(
-    n: int, classes: int = 10, seed: int = 0, noise_seed: int | None = None
+    n: int,
+    classes: int = 10,
+    seed: int = 0,
+    noise_seed: int | None = None,
+    class_amplitude: float | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Spatially-structured synthetic RGB: kron-upsampled 8x8 class
     templates (CIFAR-shaped 3x32x32). Weight-shared convs cannot
     discriminate the iid-noise templates of synthetic_arrays (each pixel
     independent), so conv-net convergence runs need low-frequency class
-    structure. ``noise_seed`` works like synthetic_arrays'."""
+    structure. ``noise_seed`` works like synthetic_arrays'.
+
+    ``class_amplitude`` (r5) controls class overlap: None keeps the
+    legacy fully-independent templates (amplitude 160, trivially
+    separable — fine for short smoke oracles but the 70k-step AlexNet
+    run saturates at 100%, a ceiling-pinned metric that cannot detect a
+    regression). A float A builds templates as shared_base + U(0, A)
+    per-class delta against the U(0, 95) pixel noise, so the task has a
+    real Bayes error: pairwise template separation is A*sqrt(3072/6) ~
+    22.6*A against sample noise sigma 27.4 along the discriminant —
+    A ~ 6 targets ~90% optimal accuracy for 10 classes (BASELINE.md r5
+    records the measured landing point of the full AlexNet run)."""
     rng = np.random.RandomState(seed)
-    small = rng.rand(classes, 3, 8, 8) * 160
+    if class_amplitude is None:
+        small = rng.rand(classes, 3, 8, 8) * 160
+    else:
+        a = float(class_amplitude)
+        base = rng.rand(1, 3, 8, 8) * (160.0 - a)
+        small = base + rng.rand(classes, 3, 8, 8) * a
     templates = np.kron(small, np.ones((1, 1, 4, 4)))
     labels = (np.arange(n) % classes).astype(np.uint8)
     nrng = rng if noise_seed is None else np.random.RandomState(noise_seed)
