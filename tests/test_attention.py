@@ -168,12 +168,14 @@ class TestRingAttention:
     def test_gradients_match_dense(self):
         q, k, v = qkv((1, 2, 128, 16))
         mesh = build_sp_mesh(1, 8)
-        g1 = jax.grad(
+        # jitted (r5): the eager ring ppermute loop serialized per-op on
+        # the virtual mesh — same equivalence assertion, less wall
+        g1 = jax.jit(jax.grad(
             lambda q: jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
-        )(q)
-        g2 = jax.grad(
+        ))(q)
+        g2 = jax.jit(jax.grad(
             lambda q: jnp.sum(attention(q, k, v, causal=True) ** 2)
-        )(q)
+        ))(q)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
 
     def test_output_stays_seq_sharded(self):

@@ -127,8 +127,11 @@ def test_plain_cluster_keeps_two_axis_mesh():
 
 def test_ring_conf_matches_dense_single_device(token_shard):
     dense = _train_losses(_lm_conf(token_shard, attn_mode="dense"))
+    # 4 workers (r5, was 8): a pure (seq=4) ring — the dp x sp pairing
+    # is test_three_axis / dryrun territory; same equivalence assertion
+    # with half the SPMD compile on this 1-core host
     cluster = _cluster(
-        "nworkers: 8\nnprocs_per_group: 4\nnseq_per_group: 4"
+        "nworkers: 4\nnprocs_per_group: 4\nnseq_per_group: 4"
     )
     ring = _train_losses(
         _lm_conf(token_shard, attn_mode="ring"), cluster

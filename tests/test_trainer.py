@@ -211,7 +211,18 @@ def test_mlp_conf_parses_and_builds(tmp_path):
     cfg.test_steps = 1
     cfg.display_frequency = 1
     logs = []
-    trainer = Trainer(cfg, seed=0, log=logs.append, prefetch=False)
+    # 1-device mesh (r5): this pins the CONF contract (parse -> build ->
+    # run), not sharding; compiling the 2500-wide matmuls as 8-way SPMD
+    # on the 1-core host cost 16.0s vs 3.7s unsharded (test_parallel
+    # owns the sharded==unsharded oracle)
+    import jax
+
+    from singa_tpu.parallel import build_mesh
+
+    trainer = Trainer(
+        cfg, mesh=build_mesh(1, 1, jax.devices()[:1]),
+        seed=0, log=logs.append, prefetch=False,
+    )
     specs = trainer.specs
     # the six FC layers declared their weights+biases
     assert sum(1 for n in specs if n.endswith("/weight")) == 6
