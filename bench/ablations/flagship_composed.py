@@ -25,9 +25,14 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    # 4 virtual devices, not 8: XLA CPU's collective rendezvous has a
+    # HARDCODED 40 s termination timeout (rendezvous.cc), and 8 device
+    # threads of a full-width MLP on this 1-core host trip it
+    # intermittently over a 10k-step run (two SIGABRTs observed).
+    # Fewer runnable threads -> fewer missed rendezvous.
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
+        + " --xla_force_host_platform_device_count=4"
     ).strip()
 
 REPO = os.path.dirname(
@@ -43,17 +48,22 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 
-def main(steps: int = 10000) -> dict:
+def main(steps: int = 10000, workdir: str | None = None) -> dict:
     from singa_tpu.config import load_model_config
+    from singa_tpu.config.schema import ClusterConfig
     from singa_tpu.data.loader import digits_arrays, write_records
     from singa_tpu.parallel import build_mesh
     from singa_tpu.trainer import ReplicaTrainer
 
-    tmp = tempfile.mkdtemp(prefix="singa_flagship_comp_")
+    tmp = workdir or tempfile.mkdtemp(prefix="singa_flagship_comp_")
+    os.makedirs(tmp, exist_ok=True)
     tr_sh = os.path.join(tmp, "train_shard")
     te_sh = os.path.join(tmp, "test_shard")
-    write_records(tr_sh, *digits_arrays("train"))
-    write_records(te_sh, *digits_arrays("test"))
+    # guard BOTH shards: a crash between the two writes must not leave
+    # a workdir that skips the test shard forever on resume
+    if not (os.path.exists(tr_sh) and os.path.exists(te_sh)):
+        write_records(tr_sh, *digits_arrays("train"), append=False)
+        write_records(te_sh, *digits_arrays("test"), append=False)
 
     cfg = load_model_config(os.path.join(REPO, "examples", "mnist", "mlp.conf"))
     for layer in cfg.neuralnet.layer:
@@ -66,11 +76,28 @@ def main(steps: int = 10000) -> dict:
     cfg.test_steps = 1
     cfg.test_frequency = 0      # eval once at the end (CPU wall budget)
     cfg.display_frequency = 2000
-    cfg.checkpoint_frequency = 0
+    # checkpoint + auto-resume: XLA CPU's 40 s rendezvous abort can kill
+    # a multi-hour virtual-mesh run at any window; a crash then costs at
+    # most 1000 steps (this is the framework's own kill-and-resume
+    # machinery doing its job — stream positions ride in the checkpoint)
+    cfg.checkpoint_frequency = 1000
+    cluster = ClusterConfig()
+    cluster.workspace = os.path.join(tmp, "ws")
+    ckdir = os.path.join(cluster.workspace, "checkpoints")
+    if os.path.isdir(ckdir):
+        cks = sorted(
+            (f for f in os.listdir(ckdir) if f.endswith(".npz")),
+            key=lambda f: int(f.split("_")[1].split(".")[0]),
+        )
+        if cks:
+            cfg.checkpoint = os.path.join(ckdir, cks[-1])
+            print(f"resuming from {cfg.checkpoint}")
 
-    mesh = build_mesh(4, 2)
+    mesh = build_mesh(2, 2)
     t0 = time.time()
-    tr = ReplicaTrainer(cfg, mesh=mesh, seed=0, log=print, prefetch=False)
+    tr = ReplicaTrainer(
+        cfg, cluster, mesh=mesh, seed=0, log=print, prefetch=False
+    )
     # the model axis is real: full-width fc weights carry a model sharding
     assert any(
         "model" in [str(a) for a in v.sharding.spec if a is not None]
@@ -86,6 +113,7 @@ def main(steps: int = 10000) -> dict:
         "partition_type": "kLayerPartition",
         "protocol": tr.protocol,
         "steps": steps,
+        "resumed_from": int(tr.start_step),
         "batch_per_replica": 64,
         "wall_sec": round(wall, 1),
         "final_test_accuracy": round(float(m["precision"]), 4),
@@ -96,4 +124,7 @@ def main(steps: int = 10000) -> dict:
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 10000)
+    main(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 10000,
+        sys.argv[2] if len(sys.argv) > 2 else None,
+    )

@@ -116,6 +116,48 @@ def _attend(q, k, v, cfg: TransformerConfig, mesh):
     return attention(q, k, v, causal=True)
 
 
+def _block_apply(params, p, x, attend, cfg, mesh=None,
+                 moe_capacity_factor=None):
+    """One transformer block with a pluggable attention implementation.
+
+    ``attend(q, k, v) -> (o, extra)`` receives/returns (B, H, S, D);
+    ``extra`` passes through (K/V caches for decode, None otherwise).
+    The SINGLE definition of block semantics — lm_apply, generate()'s
+    prefill, and the KV-cache decode step all run this body, so the
+    train->decode bit-exact parity cannot silently diverge.
+    ``moe_capacity_factor`` overrides the MoE capacity (decode passes E
+    so routing is drop-free; None keeps the training default)."""
+    b, s, _ = x.shape
+    h = _layernorm(x, params[f"{p}/ln1/scale"], params[f"{p}/ln1/bias"])
+    qkv = h @ params[f"{p}/attn/qkv"]
+    qkv = qkv.reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
+    q, k, v = (jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3))
+    o, extra = attend(q, k, v)
+    o = jnp.moveaxis(o, 1, 2).reshape(b, s, cfg.d_model)
+    x = x + o @ params[f"{p}/attn/out"]
+    h = _layernorm(x, params[f"{p}/ln2/scale"], params[f"{p}/ln2/bias"])
+    aux = jnp.float32(0.0)
+    if cfg.moe_experts:
+        from ..parallel.moe import moe_ffn, moe_ffn_dense
+
+        moe_params = {
+            k2: params[f"{p}/moe/{k2}"] for k2 in ("gate", "up", "down")
+        }
+        if mesh is not None and "expert" in getattr(mesh, "shape", {}):
+            y, aux = moe_ffn(h, moe_params, mesh)
+        elif moe_capacity_factor is not None:
+            y, aux = moe_ffn_dense(
+                h, moe_params, capacity_factor=moe_capacity_factor
+            )
+        else:
+            y, aux = moe_ffn_dense(h, moe_params)
+        x = x + y
+    else:
+        h = jax.nn.gelu(h @ params[f"{p}/mlp/up"])
+        x = x + h @ params[f"{p}/mlp/down"]
+    return x, aux, extra
+
+
 def lm_apply(
     params: dict,
     tokens: jnp.ndarray,
@@ -131,39 +173,147 @@ def lm_apply(
     b, s = tokens.shape
     x = params["embed/tok"][tokens] + params["embed/pos"][:s]
     aux_total = jnp.float32(0.0)
+    attend = lambda q, k, v: (_attend(q, k, v, cfg, mesh), None)  # noqa: E731
     for i in range(cfg.n_layers):
-        p = f"blk{i}"
-        h = _layernorm(x, params[f"{p}/ln1/scale"], params[f"{p}/ln1/bias"])
-        qkv = h @ params[f"{p}/attn/qkv"]
-        qkv = qkv.reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
-        # (B, H, S, D)
-        q, k, v = (
-            jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3)
-        )
-        o = _attend(q, k, v, cfg, mesh)
-        o = jnp.moveaxis(o, 1, 2).reshape(b, s, cfg.d_model)
-        x = x + o @ params[f"{p}/attn/out"]
-        h = _layernorm(x, params[f"{p}/ln2/scale"], params[f"{p}/ln2/bias"])
-        if cfg.moe_experts:
-            from ..parallel.moe import moe_ffn, moe_ffn_dense
-
-            moe_params = {
-                k: params[f"{p}/moe/{k}"] for k in ("gate", "up", "down")
-            }
-            if mesh is not None and "expert" in getattr(mesh, "shape", {}):
-                y, aux = moe_ffn(h, moe_params, mesh)
-            else:
-                y, aux = moe_ffn_dense(h, moe_params)
-            x = x + y
-            aux_total = aux_total + aux
-        else:
-            h = jax.nn.gelu(h @ params[f"{p}/mlp/up"])
-            x = x + h @ params[f"{p}/mlp/down"]
+        x, aux, _ = _block_apply(params, f"blk{i}", x, attend, cfg, mesh)
+        aux_total = aux_total + aux
     x = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
     logits = x @ params["embed/tok"].T
     if return_aux:
         return logits, aux_total
     return logits
+
+
+def _block_step(params, p, x, k_cache, v_cache, pos, cfg):
+    """One transformer block on a SINGLE token (B, 1, d) against the
+    (B, H, max_len, D) caches; returns (x', new_k, new_v) where new_k/v
+    are the caches with position ``pos`` filled. Shares the block body
+    with lm_apply via _block_apply; the decode MoE capacity is E
+    (drop-free, batch-independent)."""
+
+    def attend(q, k, v):
+        nk = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=2)
+        nv = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=2)
+        # masked attention over the cache: positions > pos contribute 0
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, nk) * scale
+        mask = jnp.arange(nk.shape[2])[None, None, None, :] <= pos
+        s = jnp.where(mask, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), nv)
+        return o, (nk, nv)
+
+    x, _, (nk, nv) = _block_apply(
+        params, p, x, attend, cfg,
+        moe_capacity_factor=float(max(cfg.moe_experts, 1)),
+    )
+    return x, nk, nv
+
+
+def generate(
+    params: dict,
+    prompt: jnp.ndarray,
+    cfg: TransformerConfig,
+    n_tokens: int,
+    *,
+    rng: jax.Array | None = None,
+    temperature: float = 0.0,
+) -> jnp.ndarray:
+    """Autoregressive decode with a KV cache, TPU-first.
+
+    ``prompt`` (B, P) int32 -> (B, P + n_tokens). Greedy when
+    ``temperature`` == 0, else softmax sampling at that temperature
+    (``rng`` required). The whole decode is ONE jittable program:
+    prefill runs the training forward over the prompt while caching
+    every block's K/V, then a ``lax.scan`` over ``n_tokens`` steps
+    feeds each sampled token back through single-token block steps
+    against the (B, H, max_len, D) caches — static shapes throughout,
+    position handled by masking, no dynamic Python control flow.
+
+    Beyond-parity extension: the reference is a pre-transformer system
+    with no inference path at all (SURVEY §5); this completes the LM
+    family's train -> sample loop.
+
+    MoE semantics at decode: prefill and every decode step route with
+    capacity_factor = E, which makes GShard capacity vacuous (capacity
+    >= token count), so NO token is ever dropped at inference — and a
+    row's output never depends on what else shares the batch. That is
+    the standard deployment behavior; it also means exact parity with a
+    recompute-the-whole-prefix oracle (which uses the TRAINING
+    capacity) is only defined for dense-FFN configs
+    (tests/test_generate.py pins dense parity bit-exactly, MoE
+    batch-independence explicitly).
+    """
+    b, plen = prompt.shape
+    if plen < 1:
+        raise ValueError("generate: prompt must hold at least one token")
+    total = plen + n_tokens
+    if total > cfg.max_len:
+        raise ValueError(
+            f"generate: prompt {plen} + n_tokens {n_tokens} exceeds "
+            f"max_len {cfg.max_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("generate: sampling (temperature > 0) needs rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # ---- prefill: the shared block body over the prompt, caching K/V
+    # (dense causal attention; MoE at inference capacity E = drop-free)
+    x = params["embed/tok"][prompt] + params["embed/pos"][:plen]
+    k_caches, v_caches = [], []
+    pad = ((0, 0), (0, 0), (0, cfg.max_len - plen), (0, 0))
+
+    def prefill_attend(q, k, v):
+        return attention(q, k, v, causal=True), (k, v)
+
+    for i in range(cfg.n_layers):
+        x, _, (k, v) = _block_apply(
+            params, f"blk{i}", x, prefill_attend, cfg,
+            moe_capacity_factor=float(max(cfg.moe_experts, 1)),
+        )
+        k_caches.append(jnp.pad(k, pad))
+        v_caches.append(jnp.pad(v, pad))
+    xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+    last_logits = (xf @ params["embed/tok"].T)[:, -1]
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1
+        ).astype(prompt.dtype)
+
+    k0, rng = jax.random.split(rng)
+    first = sample(last_logits, k0)
+
+    # ---- decode: scan over single-token steps ----
+    def step(carry, key):
+        token, pos, ks, vs = carry
+        x = (
+            params["embed/tok"][token][:, None, :]
+            + params["embed/pos"][pos][None, None, :]
+        )
+        new_ks, new_vs = [], []
+        for i in range(cfg.n_layers):
+            x, nk, nv = _block_step(
+                params, f"blk{i}", x, ks[i], vs[i], pos, cfg
+            )
+            new_ks.append(nk)
+            new_vs.append(nv)
+        xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+        logits = (xf @ params["embed/tok"].T)[:, 0]
+        nxt = sample(logits, key)
+        return (nxt, pos + 1, new_ks, new_vs), token
+
+    keys = jax.random.split(rng, n_tokens)
+    (last, _, _, _), out = jax.lax.scan(
+        step, (first, jnp.int32(plen), k_caches, v_caches), keys
+    )
+    # out is (n_tokens, B): the token EMITTED at each step, i.e. the
+    # sequence [first, ...]; drop nothing — `last` is the (unemitted)
+    # n_tokens+1-th sample
+    gen = jnp.moveaxis(out, 0, 1)
+    return jnp.concatenate([prompt, gen], axis=1)
 
 
 def lm_loss(

@@ -1,0 +1,148 @@
+"""Sample from a trained config-surface LM checkpoint.
+
+Beyond-parity extension (the reference has no inference path at all —
+SURVEY §5, pre-transformer system): completes the conf-driven train ->
+sample loop for the byte-level LM jobs (examples/lm/tinylm*.conf).
+
+    python -m singa_tpu.tools.generate \
+        -model_conf examples/lm/tinylm.conf \
+        -checkpoint ws/checkpoints/step_2000.npz \
+        -prompt "hello " -n 64 [-temperature 0.8] [-seed 0]
+
+Design: the net's compiled forward has a fixed sequence length S (the
+conf's training window), so decode keeps a rolling (1, S) token buffer
+— the prompt left-aligned, the tail zero-padded. Causal attention makes
+the padding invisible to every live position, and each step reads the
+logits at the last live position from the net's "head"-layer activation
+(return_acts). One XLA program serves every step (same shapes, jit
+cache hit); the models/transformer.generate path is the KV-cache fast
+variant for the code API.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="singa_tpu.tools.generate", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("-model_conf", required=True)
+    ap.add_argument("-checkpoint", required=True)
+    ap.add_argument("-prompt", default="")
+    ap.add_argument("-n", type=int, default=64)
+    ap.add_argument("-temperature", type=float, default=0.0)
+    ap.add_argument("-seed", type=int, default=0)
+    ap.add_argument(
+        "-raw", action="store_true",
+        help="emit token ids (one line) instead of decoding bytes",
+    )
+    return ap
+
+
+def _ensure_shard(cfg, vocab: int) -> None:
+    """build_net reads the data shard (it infers vocab from the token
+    stream); when the training shard is gone, synthesize a stub whose
+    max token pins the same vocab."""
+    import tempfile
+
+    from ..data.loader import write_records
+
+    for layer in cfg.neuralnet.layer:
+        p = layer.data_param
+        if layer.type == "kSequenceData" and p is not None:
+            if not os.path.exists(p.path):
+                stub = np.zeros((2, 16), dtype=np.uint8)
+                stub[0, 0] = vocab - 1
+                tmp = tempfile.mkdtemp(prefix="singa_gen_stub_")
+                path = os.path.join(tmp, "stub_shard")
+                write_records(path, stub, np.zeros((2,), np.uint8))
+                p.path = path
+
+
+def generate_from_net(net, params, prompt_tokens, n: int,
+                      temperature: float, seed: int) -> list[int]:
+    """Rolling-buffer greedy/temperature decode over the conf net."""
+    import jax
+    import jax.numpy as jnp
+
+    (dl,) = net.datalayers
+    # sequence length = the data layer's declared window
+    s = dl.out_shape[1]
+    # the logits layer is whatever feeds the LM loss
+    (loss_layer,) = net.losslayers
+    head = next(
+        src for src in loss_layer.srclayers if src != dl.name
+    )
+
+    @jax.jit
+    def logits_at(params, tokens, pos):
+        batch = {dl.name: {"image": tokens, "label": jnp.zeros((1,), jnp.int32)}}
+        _, _, acts = net.forward(
+            params, batch, training=False, rng=None, return_acts=True
+        )
+        return acts[head][0, pos]
+
+    toks = list(prompt_tokens)
+    if not toks:
+        toks = [0]
+    if len(toks) >= s:
+        toks = toks[-(s - 1):]
+    rng = jax.random.PRNGKey(seed)
+    out = list(toks)
+    for _ in range(n):
+        window = out[-(s - 1):] if len(out) >= s else out
+        buf = np.zeros((1, s), np.int32)
+        buf[0, : len(window)] = window
+        lg = logits_at(params, jnp.asarray(buf), len(window) - 1)
+        if temperature <= 0.0:
+            nxt = int(jnp.argmax(lg))
+        else:
+            rng, k = jax.random.split(rng)
+            nxt = int(jax.random.categorical(k, lg / temperature))
+        out.append(nxt)
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    from ..config import load_model_config
+    from ..graph.builder import build_net
+    from ..trainer.checkpoint import load_checkpoint
+
+    step, params, _state, _buffers = load_checkpoint(args.checkpoint)
+    embed = next(
+        (v for k, v in params.items() if k.endswith("/tok")), None
+    )
+    if embed is None:
+        print("checkpoint has no token embedding (not an LM job?)",
+              file=sys.stderr)
+        return 2
+    vocab = embed.shape[0]
+    cfg = load_model_config(args.model_conf)
+    _ensure_shard(cfg, vocab)
+    net = build_net(cfg, "kTest")
+
+    import jax.numpy as jnp
+
+    params = {k: jnp.asarray(v) for k, v in params.items()}
+    prompt = [b % vocab for b in args.prompt.encode()]
+    toks = generate_from_net(
+        net, params, prompt, args.n, args.temperature, args.seed
+    )
+    if args.raw:
+        print(" ".join(str(t) for t in toks))
+    else:
+        sys.stdout.buffer.write(bytes(t % 256 for t in toks))
+        sys.stdout.buffer.write(b"\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
