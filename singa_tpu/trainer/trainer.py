@@ -901,10 +901,23 @@ class Trainer:
         # end of a training run. Fully-replicated multi-process arrays
         # are fine for npz (every host holds the whole value), so they
         # keep the configured format.
-        spans_procs = any(
-            not v.is_fully_addressable
-            and not v.sharding.is_fully_replicated
-            for v in self.params.values()
+        def _spanning(arrs):
+            return any(
+                not v.is_fully_addressable
+                and not v.sharding.is_fully_replicated
+                for v in arrs
+            )
+
+        # check params AND state AND buffers: they can disagree — e.g.
+        # the replica engine's protocol round returns params replicated
+        # (the scan re-lays them out) while updater slots keep the
+        # process-spanning replica sharding
+        spans_procs = (
+            _spanning(self.params.values())
+            or _spanning(
+                v for slots in self.state.values() for v in slots.values()
+            )
+            or _spanning(self.buffers.values())
         )
         if self.cfg.checkpoint_format == "sharded" or spans_procs:
             from .sharded_ckpt import save_sharded

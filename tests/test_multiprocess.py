@@ -162,6 +162,59 @@ def test_two_process_training_matches_single_process(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_elastic_replica_matches_single_process(tmp_path):
+    """The replica PROTOCOLS across OS process boundaries (r5): each
+    process is one worker group holding one replica, reconciling
+    through Elastic — the reference's actual deployment topology
+    (worker groups were separate processes syncing via the PS over TCP,
+    src/worker/worker.cc:50-55). nservers: 1 + async cluster routes the
+    CLI to the ReplicaTrainer; the replica axis spans the 2-process
+    mesh. Oracle: same trajectory as the single-process ReplicaTrainer
+    on the same (2,1) geometry."""
+    from singa_tpu.trainer import ReplicaTrainer
+
+    shard = str(tmp_path / "shard")
+    write_records(shard, *synthetic_arrays(128, seed=5))
+    conf = _conf_text(shard).replace(
+        'param_type: "Param"',
+        'param_type: "Elastic" moving_rate: 0.3 '
+        'sync_frequency: 2 warmup_steps: 2',
+    )
+    assert "Elastic" in conf, "_conf_text changed; protocol swap no-opped"
+    model_conf = tmp_path / "job.conf"
+    model_conf.write_text(conf)
+    cluster_conf = tmp_path / "cluster.conf"
+    cluster_conf.write_text(
+        'nworkers: 2\nnprocs_per_group: 1\nnservers: 1\n'
+        f'workspace: "{tmp_path}/ws"\n'
+    )
+    results = _launch_job(tmp_path, model_conf, cluster_conf, 2)
+    dumps = [p for p, _ in results.values()]
+    metas = [m for _, m in results.values()]
+    for m in metas:
+        assert m["process_count"] == 2
+        assert m["mesh"] == {"data": 2, "model": 1}
+    for name in dumps[0]:
+        np.testing.assert_array_equal(
+            dumps[0][name], dumps[1][name], err_msg=name
+        )
+        assert dumps[0][name].shape[0] == 2, name  # replica axis
+
+    cfg = parse_model_config(conf)
+    solo = ReplicaTrainer(
+        cfg, seed=0, log=lambda s: None, prefetch=False,
+        mesh=build_mesh(2, 1),
+    )
+    solo.run()
+    for name in dumps[0]:
+        np.testing.assert_allclose(
+            dumps[0][name], np.asarray(solo.params[name]),
+            rtol=1e-4, atol=1e-5,
+            err_msg=f"2-process Elastic diverged from single-process: {name}",
+        )
+
+
+@pytest.mark.slow
 def test_four_process_dp_x_tp_matches_single_process(tmp_path):
     """Cross-process MODEL partitioning (VERDICT r4 #1b): a 4-process
     2x2 dp x tp job — nprocs_per_group: 2 puts the kLayerPartition model

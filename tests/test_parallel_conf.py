@@ -326,7 +326,9 @@ def test_pp_plan_rejects_mismatched_stage_count(token_shard):
 
 
 @pytest.mark.parametrize(
-    "conf", ["tinylm_ring.conf", "tinylm_moe.conf", "tinylm_pp.conf"]
+    "conf",
+    ["tinylm_ring.conf", "tinylm_moe.conf", "tinylm_pp.conf",
+     "tinylm_d128.conf"],
 )
 def test_shipped_lm_variants_build(conf, tmp_path):
     from singa_tpu.config import load_model_config
