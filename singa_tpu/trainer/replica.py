@@ -395,16 +395,33 @@ class ReplicaTrainer(Trainer):
         if path is not None and self.center is not None:
             from .checkpoint import save_checkpoint
 
+            def host_view(v):
+                """np-ready view; replica-axis arrays SPAN processes in
+                multi-host jobs (e.g. the RandomSync snapshot on the
+                2-process topology) — allgather them collectively.
+                Every rank walks the same dict order, so the collective
+                calls line up."""
+                if (
+                    jax.process_count() > 1
+                    and not v.is_fully_addressable
+                    and not v.sharding.is_fully_replicated
+                ):
+                    from jax.experimental import multihost_utils
+
+                    return multihost_utils.process_allgather(v, tiled=True)
+                return v
+
             # server-side trees store LOGICAL shapes like the base npz
             # format (resume re-pads for its mesh)
             server = {
-                n: self._unpad_one(n, v) for n, v in self.center.items()
+                n: host_view(self._unpad_one(n, v))
+                for n, v in self.center.items()
             }
             server["__sample_ratio__"] = jnp.float32(self.sample_ratio)
             snap = (
                 {
                     "__snapshot__": {
-                        n: self._unpad_one(n, v)
+                        n: host_view(self._unpad_one(n, v))
                         for n, v in self.snapshot.items()
                     }
                 }

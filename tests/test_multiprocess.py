@@ -162,25 +162,31 @@ def test_two_process_training_matches_single_process(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_elastic_replica_matches_single_process(tmp_path):
+@pytest.mark.parametrize("protocol", ["Elastic", "RandomSync"])
+def test_two_process_replica_protocol_matches_single_process(
+    tmp_path, protocol
+):
     """The replica PROTOCOLS across OS process boundaries (r5): each
     process is one worker group holding one replica, reconciling
-    through Elastic — the reference's actual deployment topology
-    (worker groups were separate processes syncing via the PS over TCP,
-    src/worker/worker.cc:50-55). nservers: 1 + async cluster routes the
-    CLI to the ReplicaTrainer; the replica axis spans the 2-process
-    mesh. Oracle: same trajectory as the single-process ReplicaTrainer
-    on the same (2,1) geometry."""
+    through the async protocol — the reference's actual deployment
+    topology (worker groups were separate processes syncing via the PS
+    over TCP, src/worker/worker.cc:50-55). nservers: 1 + async cluster
+    routes the CLI to the ReplicaTrainer; the replica axis spans the
+    2-process mesh (RandomSync additionally proves the host-side index
+    sampling stays rank-consistent — every process draws from the same
+    seeded stream). Oracle: same trajectory as the single-process
+    ReplicaTrainer on the same (2,1) geometry."""
     from singa_tpu.trainer import ReplicaTrainer
 
     shard = str(tmp_path / "shard")
     write_records(shard, *synthetic_arrays(128, seed=5))
+    moving = "0.3" if protocol == "Elastic" else "0.0"
     conf = _conf_text(shard).replace(
         'param_type: "Param"',
-        'param_type: "Elastic" moving_rate: 0.3 '
+        f'param_type: "{protocol}" moving_rate: {moving} '
         'sync_frequency: 2 warmup_steps: 2',
     )
-    assert "Elastic" in conf, "_conf_text changed; protocol swap no-opped"
+    assert protocol in conf, "_conf_text changed; protocol swap no-opped"
     model_conf = tmp_path / "job.conf"
     model_conf.write_text(conf)
     cluster_conf = tmp_path / "cluster.conf"
