@@ -329,8 +329,16 @@ BENCHES = (
 )
 
 
+#: set by main(): a partial (workload-selected) run writes its JSON to
+#: the .partial sidecar so it cannot clobber the canonical full-suite
+#: BENCH.json record
+_PARTIAL_RUN = False
+
+
 def main() -> int:
+    global _PARTIAL_RUN
     only = set(sys.argv[1:])
+    _PARTIAL_RUN = bool(only)
     unknown = only - {name for name, _ in BENCHES}
     if unknown:
         print(f"unknown workload(s): {sorted(unknown)}; "
@@ -392,9 +400,10 @@ def _emit(out: dict) -> None:
     relocate)."""
     line = json.dumps(out)
     print(line)
-    path = os.environ.get(
-        "SINGA_TPU_BENCH_OUT", os.path.join(REPO, "BENCH.json")
+    default = os.path.join(
+        REPO, "BENCH.partial.json" if _PARTIAL_RUN else "BENCH.json"
     )
+    path = os.environ.get("SINGA_TPU_BENCH_OUT", default)
     try:
         with open(path, "w") as f:
             f.write(line + "\n")

@@ -618,7 +618,11 @@ class Trainer:
         trainer overrides with a (replicas, batch) grid)."""
         return self._flat_batch_indices(pos0, i, bs, n)
 
-    def _make_chunk_fn(self, nsteps: int) -> Callable:
+    def _chunk_body(self, nsteps: int) -> Callable:
+        """The UNJITTED nsteps-step scan body: (params, state, buffers,
+        step0, pos0s, data) -> (params, state, buffers, summed_metrics).
+        _make_chunk_fn jits it; the replica trainer composes it with a
+        protocol round in one program (fused sync windows)."""
         pipes = self._pipelines[id(self.train_net)]
         meta = {
             name: (pipes[name].batchsize, pipes[name].n)
@@ -654,7 +658,10 @@ class Trainer:
                 lambda a: a.sum(axis=0), metrics
             )
 
-        return jax.jit(chunk_fn, donate_argnums=(0, 1, 2))
+        return chunk_fn
+
+    def _make_chunk_fn(self, nsteps: int) -> Callable:
+        return jax.jit(self._chunk_body(nsteps), donate_argnums=(0, 1, 2))
 
     def train_chunk(self, step0: int, nsteps: int) -> None:
         """Run nsteps consecutive train steps as ONE compiled program.
@@ -665,23 +672,39 @@ class Trainer:
         schedule (each scan iteration sees its true step number)."""
         if nsteps not in self._chunk_fns:
             self._chunk_fns[nsteps] = self._make_chunk_fn(nsteps)
+        self._run_chunk(self._chunk_fns[nsteps], (), step0, nsteps)
+
+    def _run_chunk(self, fn, extra_in: tuple, step0: int, nsteps: int):
+        """Shared chunk-dispatch scaffolding (ONE copy — the replica
+        trainer's fused sync windows reuse it).
+
+        ``fn(params, state, buffers, *extra_in, step0, pos0s, data) ->
+        (params, state, buffers, *extra_out, summed_metrics)``;
+        ``extra_out`` (protocol state carried through a fused program)
+        is handed to _store_chunk_extras."""
         pipes = self._pipelines[id(self.train_net)]
         pos0s = {
             name: jnp.int32(pipe.position) for name, pipe in pipes.items()
         }
         with self.timers.phase("train"):
-            (self.params, self.state, self.buffers, summed) = (
-                self._chunk_fns[nsteps](
-                    self.params, self.state, self.buffers,
-                    jnp.int32(step0), pos0s,
-                    self._dev_data[id(self.train_net)],
-                )
+            out = fn(
+                self.params, self.state, self.buffers, *extra_in,
+                jnp.int32(step0), pos0s,
+                self._dev_data[id(self.train_net)],
             )
+        self.params, self.state, self.buffers, *extra_out, summed = out
+        if extra_out:
+            self._store_chunk_extras(tuple(extra_out))
         for name, pipe in pipes.items():
             pipe.advance(nsteps * self._batches_per_step)
         # metrics arrive pre-summed over the chunk; Performance pulls to
         # host only at display time
         self.perf.update_summed(summed, nsteps)
+
+    def _store_chunk_extras(self, extra: tuple) -> None:
+        raise NotImplementedError(
+            "chunk fn returned extra outputs but no handler is defined"
+        )
 
     def _next_fire(self, cur: int, freq: int, after: int) -> float:
         """Smallest s >= cur with _now(s, freq, after), or +inf."""

@@ -190,8 +190,11 @@ def test_two_process_replica_protocol_matches_single_process(
     model_conf = tmp_path / "job.conf"
     model_conf.write_text(conf)
     cluster_conf = tmp_path / "cluster.conf"
+    # bandwidth 1e9 pins sample_ratio at 1.0 on every rank: the oracle
+    # wants a deterministic trajectory, not the wall-clock-derived
+    # SyncConfig throttle (which is also rank-broadcast now)
     cluster_conf.write_text(
-        'nworkers: 2\nnprocs_per_group: 1\nnservers: 1\n'
+        'nworkers: 2\nnprocs_per_group: 1\nnservers: 1\nbandwidth: 1e9\n'
         f'workspace: "{tmp_path}/ws"\n'
     )
     results = _launch_job(tmp_path, model_conf, cluster_conf, 2)
