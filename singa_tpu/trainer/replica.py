@@ -105,7 +105,8 @@ class ReplicaTrainer(Trainer):
         self._warmup_timed = 0
         self._sync_rng = np.random.RandomState(seed ^ 0x5EED)
         self._sync_jit: Callable | None = None
-        self._fused_chunk_fns: dict[int, Callable] = {}
+        #: (nwindows, window_len) -> jitted multi-window program
+        self._fused_chunk_fns: dict[tuple[int, int], Callable] = {}
         super().__init__(
             model_cfg,
             cluster_cfg,
@@ -273,47 +274,81 @@ class ReplicaTrainer(Trainer):
         k = i * self.nreplicas + jnp.arange(self.nreplicas)[:, None]
         return (pos0 + k * bs + jnp.arange(bs)[None, :]) % n
 
+    def _device_pure_sync(self) -> bool:
+        """True when the protocol round is a pure function of device
+        state — Elastic always, RandomSync at full coverage (the
+        sampled path draws fresh host index tensors per round) — i.e.
+        when rounds can compile INTO the chunk program."""
+        return self.protocol == "Elastic" or self.sample_ratio >= 1.0
+
     def _chunk_len(self, step: int) -> int:
         """Warmup steps run singly (their wall-clock feeds SyncConfig and
-        the bootstrap fires between them); afterwards chunks additionally
-        end at the sync cadence so a protocol round follows each window."""
+        the bootstrap fires between them); afterwards chunks end at the
+        sync cadence so a protocol round follows each window — EXCEPT
+        when rounds are device-pure and the chunk starts window-aligned:
+        then whole windows stack into one multi-window program (the
+        rounds run between inner scans, one dispatch for many windows)."""
         if step < self.warmup_steps or not self._bootstrapped:
             return 1
         n = super()._chunk_len(step)
-        if self.sync_frequency > 0:
-            # smallest s >= step with (s+1) % freq == 0 (sync_now)
-            fire = step + (-(step + 1)) % self.sync_frequency
-            n = min(n, fire - step + 1)
+        freq = self.sync_frequency
+        if freq > 0:
+            # multi-window stacking needs every sub-window's fire to be
+            # a REAL sync_now fire: sync_now requires step > warmup, so
+            # freq == 1 starting exactly at the warmup boundary would
+            # give the first window a spurious round (review-caught r5)
+            aligned = (
+                self._device_pure_sync()
+                and step % freq == 0
+                and n >= freq
+                and (freq > 1 or step > self.warmup_steps)
+            )
+            if aligned:
+                n = (n // freq) * freq  # whole windows, each ends at a fire
+            else:
+                # smallest s >= step with (s+1) % freq == 0 (sync_now)
+                fire = step + (-(step + 1)) % freq
+                n = min(n, fire - step + 1)
         return max(1, int(n))
 
     def train_chunk(self, step0: int, nsteps: int) -> None:
+        freq = self.sync_frequency
         last = step0 + nsteps - 1
         fires = self._bootstrapped and sync_now(
-            last, self.sync_frequency, self.warmup_steps
+            last, freq, self.warmup_steps
         )
         # FUSED sync windows (r5): when the window ends at a sync fire
-        # and the protocol round is a pure function of device state
-        # (Elastic always; RandomSync at full coverage — the sampled
-        # path needs fresh host-drawn index tensors per round), the
-        # round runs INSIDE the chunk's compiled program. One dispatch
-        # per window instead of two — on the tunneled chip the extra
-        # round trip measured ~0.3 ms/step of the replica bench row.
-        fusable = fires and (
-            self.protocol == "Elastic" or self.sample_ratio >= 1.0
-        )
+        # and the round is device-pure, the round compiles INTO the
+        # chunk program; window-aligned chunks additionally stack
+        # MULTIPLE windows into one program (outer lax.scan over
+        # windows, round between inner scans) — one dispatch where the
+        # split engine paid 2 per window. Measured on chip: the replica
+        # bench row went 0.828 (split) -> 0.675 (single-window fused)
+        # -> see BASELINE r5 for the multi-window number.
+        fusable = fires and self._device_pure_sync()
         if not fusable:
             super().train_chunk(step0, nsteps)
             if fires:
                 with self.timers.phase("sync"):
                     self._sync_round()
             return
-        if nsteps not in self._fused_chunk_fns:
-            self._fused_chunk_fns[nsteps] = self._make_fused_chunk_fn(nsteps)
+        if (
+            freq > 0
+            and step0 % freq == 0
+            and nsteps % freq == 0
+            and (freq > 1 or step0 > self.warmup_steps)
+        ):
+            nwin, wlen = nsteps // freq, freq
+        else:
+            nwin, wlen = 1, nsteps
+        key = (nwin, wlen)
+        if key not in self._fused_chunk_fns:
+            self._fused_chunk_fns[key] = self._make_fused_chunk_fn(nwin, wlen)
         extra_in = (
             (self.center,) if self.protocol == "Elastic"
             else (self.snapshot, self.center)
         )
-        self._run_chunk(self._fused_chunk_fns[nsteps], extra_in, step0, nsteps)
+        self._run_chunk(self._fused_chunk_fns[key], extra_in, step0, nsteps)
 
     def _store_chunk_extras(self, extra: tuple) -> None:
         if len(extra) == 1:
@@ -321,37 +356,58 @@ class ReplicaTrainer(Trainer):
         else:
             self.snapshot, self.center = extra
 
-    def _make_fused_chunk_fn(self, nsteps: int):
-        """jit(chunk body + protocol round): the replica window and its
-        sync reconcile in ONE compiled program."""
-        body = self._chunk_body(nsteps)
+    def _make_fused_chunk_fn(self, nwindows: int, wlen: int):
+        """jit(nwindows x (wlen-step inner scan + protocol round)): sync
+        windows and their rounds reconcile in ONE compiled program."""
+        body = self._chunk_body(wlen)
+        pipes = self._pipelines[id(self.train_net)]
+        # per-stream position advance of one window
+        adv = {
+            name: wlen * self._batches_per_step * pipes[name].batchsize
+            for name in self._dev_data[id(self.train_net)]
+        }
+        nrec = {
+            name: pipes[name].n
+            for name in self._dev_data[id(self.train_net)]
+        }
+        elastic = self.protocol == "Elastic"
+        alpha = (
+            self.moving_rate if self.moving_rate > 0 else self.sample_ratio
+        )
 
-        if self.protocol == "Elastic":
-            alpha = (
-                self.moving_rate if self.moving_rate > 0
-                else self.sample_ratio
-            )
-
-            def fused(params, state, buffers, center, step0, pos0s, data):
-                params, state, buffers, metrics = body(
-                    params, state, buffers, step0, pos0s, data
-                )
-                params, center = elastic_sync(params, center, alpha)
-                return params, state, buffers, center, metrics
-
-            return jax.jit(fused, donate_argnums=(0, 1, 2, 3))
-
-        def fused(params, state, buffers, snapshot, center, step0, pos0s,
-                  data):
+        def one_window(carry, w, step0, pos0s, data):
+            params, state, buffers, *proto = carry
+            s0 = step0 + w * wlen
+            p0s = {
+                name: (pos0s[name] + w * adv[name]) % nrec[name]
+                for name in pos0s
+            }
             params, state, buffers, metrics = body(
-                params, state, buffers, step0, pos0s, data
+                params, state, buffers, s0, p0s, data
             )
+            if elastic:
+                (center,) = proto
+                params, center = elastic_sync(params, center, alpha)
+                return (params, state, buffers, center), metrics
+            snapshot, center = proto
             params, snapshot, center = random_sync(
                 params, snapshot, center, None, full_coverage=True
             )
-            return params, state, buffers, snapshot, center, metrics
+            return (params, state, buffers, snapshot, center), metrics
 
-        return jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4))
+        def fused(params, state, buffers, *rest):
+            *proto, step0, pos0s, data = rest
+            carry, metrics = jax.lax.scan(
+                lambda c, w: one_window(c, w, step0, pos0s, data),
+                (params, state, buffers, *proto),
+                jnp.arange(nwindows),
+            )
+            params, state, buffers, *proto = carry
+            summed = jax.tree.map(lambda a: a.sum(axis=0), metrics)
+            return (params, state, buffers, *proto, summed)
+
+        donate = (0, 1, 2, 3) if elastic else (0, 1, 2, 3, 4)
+        return jax.jit(fused, donate_argnums=donate)
 
     def train_one_batch(self, step: int) -> None:
         import time

@@ -509,6 +509,36 @@ class TestReplicaProductionEngine:
                 rtol=2e-5, atol=2e-6,
             )
 
+    def test_freq1_warmup_boundary_chunk_matches_per_step(self, tmp_path):
+        """sync_frequency 1 starting exactly at the warmup boundary:
+        sync_now requires step > warmup, so the first post-warmup step
+        must NOT sync — a naive multi-window stack would give it a
+        spurious round (review-caught r5). Oracle: chunked == per-step."""
+        cfg_a = _set_sync(
+            _replica_conf(tmp_path / "a", train_steps=10), "Elastic",
+            moving_rate=0.3, sync_frequency=1, warmup=4,
+        )
+        t_a = ReplicaTrainer(
+            cfg_a, mesh=build_mesh(4, 1), seed=2, log=lambda s: None,
+            prefetch=False,
+        )
+        t_a.run()
+        cfg_b = _set_sync(
+            _replica_conf(tmp_path / "b", train_steps=10), "Elastic",
+            moving_rate=0.3, sync_frequency=1, warmup=4,
+        )
+        t_b = ReplicaTrainer(
+            cfg_b, mesh=build_mesh(4, 1), seed=2, log=lambda s: None,
+            prefetch=False, device_cache=False,
+        )
+        for s in range(10):
+            t_b.run_one_batch(s)
+        for n in t_a.params:
+            np.testing.assert_allclose(
+                np.asarray(t_a.params[n]), np.asarray(t_b.params[n]),
+                rtol=2e-5, atol=2e-6, err_msg=n,
+            )
+
     def test_chunk_windows_respect_sync_cadence(self, tmp_path):
         cfg = _set_sync(
             _replica_conf(tmp_path, train_steps=20), "Elastic",
@@ -523,9 +553,13 @@ class TestReplicaProductionEngine:
         for s in range(6):
             t.train_one_batch(s)
         assert t._bootstrapped
-        # sync fires where (s+1) % 4 == 0 -> from step 8 the window runs
-        # to step 11 inclusive (4 steps)
-        assert t._chunk_len(8) == 4
+        # sync fires where (s+1) % 4 == 0. Step 8 is window-ALIGNED and
+        # Elastic rounds are device-pure, so WHOLE windows stack into
+        # one multi-window program: 12 remaining steps = 3 windows
+        # (r5 multi-window fusion; every sub-window still ends at a
+        # fire — the chunk==per-step oracle above pins equivalence)
+        assert t._chunk_len(8) == 12
+        # unaligned starts still stop at the next fire
         assert t._chunk_len(9) == 3
 
     def test_replica_batchnorm_trains_per_replica_buffers(self, tmp_path):
