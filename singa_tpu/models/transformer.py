@@ -258,13 +258,17 @@ def generate(
         rng = jax.random.PRNGKey(0)
 
     # ---- prefill: the shared block body over the prompt, caching K/V
-    # (dense causal attention; MoE at inference capacity E = drop-free)
+    # (memory-aware attention — dense below the score-footprint
+    # threshold, flash kernel above, so a long-context prompt cannot
+    # materialize an S x S score tensor; MoE at inference capacity E)
+    from ..ops.attention import auto_attention
+
     x = params["embed/tok"][prompt] + params["embed/pos"][:plen]
     k_caches, v_caches = [], []
     pad = ((0, 0), (0, 0), (0, cfg.max_len - plen), (0, 0))
 
     def prefill_attend(q, k, v):
-        return attention(q, k, v, causal=True), (k, v)
+        return auto_attention(q, k, v, causal=True), (k, v)
 
     for i in range(cfg.n_layers):
         x, _, (k, v) = _block_apply(
