@@ -92,6 +92,47 @@ def init_lm(rng: jax.Array, cfg: TransformerConfig) -> dict:
     return params
 
 
+def lm_param_shardings(mesh, params: dict, axis: str = "model") -> dict:
+    """Tensor-parallel specs for the code-API param tree.
+
+    The MLP gets the classic Megatron column/row pair (``up`` shards its
+    output dim, ``down`` the matching contraction dim: one psum per
+    block, gelu stays local). The attention projections (``qkv``,
+    ``out``) shard their CONTRACTION dim instead: the packed ``(d, 3d)``
+    qkv layout reshapes to ``(3, heads, head_dim)`` downstream, and a
+    contiguous column shard of the 3d dim crosses the q|k|v thirds for
+    every practical width (head-parallel attention would need an
+    unpacked/interleaved weight layout) — contraction sharding still
+    divides the projection FLOPs and weight memory evenly and never
+    fights the reshape; only the S^2 attention core itself stays
+    replicated. Embeddings / norms / MoE trees stay replicated. A dim
+    ``axis`` does not divide — or a mesh without ``axis`` at all —
+    falls back to replicated: the annotation is a performance hint,
+    never a constraint. Beyond-parity extension: the conf surface gets
+    TP from kLayerPartition (parallel/shardings.py); this gives the
+    code-API LM (init_lm / lm_apply / generate) the same axis without a
+    conf.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    width = dict(mesh.shape).get(axis, 0)
+
+    def spec_for(name: str, v) -> PartitionSpec:
+        if not width:  # mesh has no such axis: everything replicated
+            return PartitionSpec()
+        if name.endswith("/mlp/up"):
+            dim = 1
+        elif name.endswith(("/attn/qkv", "/attn/out", "/mlp/down")):
+            dim = 0
+        else:
+            return PartitionSpec()
+        if v.ndim != 2 or v.shape[dim] % width:
+            return PartitionSpec()
+        return PartitionSpec(*(axis if d == dim else None for d in range(2)))
+
+    return {k: NamedSharding(mesh, spec_for(k, v)) for k, v in params.items()}
+
+
 def _layernorm(x, scale, bias, eps=1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
