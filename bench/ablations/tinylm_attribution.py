@@ -1,0 +1,86 @@
+"""tinylm (40% MFU) component attribution — chip, bench.py windows.
+
+The r5 VERDICT-style accounting every other bench row has: which op
+classes own the non-MXU 60% of the tinylm step? Method: monkeypatch one
+layer class's apply to (near-)identity before the Trainer builds, run
+the standard two-window bench, and read the step-time delta — each
+variant removes that class's forward AND backward. Variants:
+
+  base       unmodified tinylm.conf (d=256, ff=1024, S=128, B=64)
+  attn_id    kAttention -> identity (qkv/out projections + S^2 core gone)
+  ln_id      kLayerNorm -> identity (fp32 stats + scale/bias gone)
+  nogelu     kDense keeps matmul+bias, drops the activation
+  cheap_loss kLMLoss -> mean(logits) (log_softmax + gather + argmax gone)
+
+Run: python bench/ablations/tinylm_attribution.py
+"""
+import os
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+sys.path.insert(0, REPO)
+
+import jax.numpy as jnp  # noqa: E402
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+from singa_tpu.layers import sequence as seq  # noqa: E402
+
+
+def run(name):
+    w = bench.bench_tinylm(name=name)
+    print(f"{name:10s} {w['step_ms']*1e3:7.1f} us/step  "
+          f"({w['samples_per_sec']:.0f} samples/s)")
+    return w["step_ms"] * 1e3
+
+
+def main():
+    rows = {}
+    rows["base"] = run("base")
+
+    orig_attn = seq.AttentionLayer.apply
+    seq.AttentionLayer.apply = (
+        lambda self, params, inputs, *, training, rng=None: inputs[0]
+    )
+    rows["attn_id"] = run("attn_id")
+    seq.AttentionLayer.apply = orig_attn
+
+    orig_ln = seq.LayerNormLayer.apply
+    seq.LayerNormLayer.apply = (
+        lambda self, params, inputs, *, training, rng=None: inputs[0]
+    )
+    rows["ln_id"] = run("ln_id")
+    seq.LayerNormLayer.apply = orig_ln
+
+    orig_dense = seq.DenseLayer.apply
+
+    def dense_noact(self, params, inputs, *, training, rng=None):
+        w = params[self.w]
+        out = inputs[0].astype(w.dtype) @ w
+        if self.bias_term:
+            out = out + params[self.b]
+        return out
+
+    seq.DenseLayer.apply = dense_noact
+    rows["nogelu"] = run("nogelu")
+    seq.DenseLayer.apply = orig_dense
+
+    orig_loss = seq.LMLossLayer.apply
+
+    def cheap_loss(self, params, inputs, *, training, rng=None):
+        logits, _ = inputs
+        loss = jnp.mean(logits.astype(jnp.float32))
+        return loss, {"loss": loss, "precision": jnp.float32(0)}
+
+    seq.LMLossLayer.apply = cheap_loss
+    rows["cheap_loss"] = run("cheap_loss")
+    seq.LMLossLayer.apply = orig_loss
+
+    base = rows["base"]
+    print("\ncomponent costs (base minus ablated):")
+    for k in ("attn_id", "ln_id", "nogelu", "cheap_loss"):
+        print(f"  {k:10s} {base - rows[k]:7.1f} us")
+
+
+if __name__ == "__main__":
+    main()
