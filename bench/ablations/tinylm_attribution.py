@@ -21,7 +21,6 @@ REPO = os.path.join(os.path.dirname(__file__), "..", "..")
 sys.path.insert(0, REPO)
 
 import jax.numpy as jnp  # noqa: E402
-import jax  # noqa: E402
 
 import bench  # noqa: E402
 from singa_tpu.layers import sequence as seq  # noqa: E402

@@ -104,10 +104,17 @@ def tokenize(text: str) -> list[tuple[str, Any]]:
     return tokens
 
 
+#: message-nesting bound: real confs are ~4 deep; the recursive-descent
+#: parser must fail with TextProtoError, not RecursionError, on
+#: pathological input (tests/test_textproto_fuzz.py)
+_MAX_DEPTH = 100
+
+
 class _Parser:
     def __init__(self, tokens: list[tuple[str, Any]]):
         self.tokens = tokens
         self.pos = 0
+        self.depth = 0
 
     def peek(self) -> tuple[str, Any] | None:
         return self.tokens[self.pos] if self.pos < len(self.tokens) else None
@@ -127,6 +134,17 @@ class _Parser:
         occurrence), or a non-repeated message (merge occurrences field-wise,
         matching protobuf text-format merge semantics).
         """
+        self.depth += 1
+        if self.depth > _MAX_DEPTH:
+            raise TextProtoError(
+                f"message nesting deeper than {_MAX_DEPTH} levels"
+            )
+        try:
+            return self._parse_fields(toplevel=toplevel)
+        finally:
+            self.depth -= 1
+
+    def _parse_fields(self, *, toplevel: bool) -> dict[str, list[Any]]:
         fields: dict[str, list[Any]] = {}
         while True:
             tok = self.peek()
