@@ -78,6 +78,22 @@ def _int32(value: int) -> int:
     return int(value)
 
 
+def _read_f32s(buf: bytes, pos: int, count: int) -> tuple[tuple, int]:
+    """Bounds-checked little-endian float reads: a truncated buffer must
+    raise RecordError, never leak struct.error (fuzz-pinned)."""
+    end = pos + 4 * count
+    if end > len(buf):
+        raise RecordError("truncated float field")
+    return struct.unpack_from(f"<{count}f", buf, pos), end
+
+
+def _read_bytes(buf: bytes, pos: int, ln: int) -> tuple[bytes, int]:
+    end = pos + ln
+    if end > len(buf):
+        raise RecordError("truncated bytes field")
+    return buf[pos:end], end
+
+
 def _skip_field(buf: bytes, pos: int, wire_type: int) -> int:
     if wire_type == 0:
         _, pos = _read_varint(buf, pos)
@@ -142,27 +158,28 @@ def _decode_image(buf: bytes) -> ImageRecord:
         elif field == 1 and wt == 2:  # packed repeated int32
             ln, pos = _read_varint(buf, pos)
             end = pos + ln
+            if end > len(buf):
+                raise RecordError("truncated packed field")
             while pos < end:
                 v, pos = _read_varint(buf, pos)
                 rec.shape.append(_int32(v))
+            if pos != end:  # a varint straddled the declared boundary
+                raise RecordError("malformed packed field")
         elif field == 2 and wt == 0:
             v, pos = _read_varint(buf, pos)
             rec.label = _int32(v)
         elif field == 3 and wt == 2:
             ln, pos = _read_varint(buf, pos)
-            rec.pixel = buf[pos : pos + ln]
-            pos += ln
+            rec.pixel, pos = _read_bytes(buf, pos, ln)
         elif field == 4 and wt == 5:
-            rec.data.append(struct.unpack_from("<f", buf, pos)[0])
-            pos += 4
+            vals, pos = _read_f32s(buf, pos, 1)
+            rec.data.append(vals[0])
         elif field == 4 and wt == 2:  # packed repeated float
             ln, pos = _read_varint(buf, pos)
             if ln % 4:
                 raise RecordError("bad packed float length")
-            rec.data.extend(
-                struct.unpack_from(f"<{ln // 4}f", buf, pos)
-            )
-            pos += ln
+            vals, pos = _read_f32s(buf, pos, ln // 4)
+            rec.data.extend(vals)
         else:
             pos = _skip_field(buf, pos, wt)
     return rec
@@ -227,17 +244,16 @@ def decode_datum(buf: bytes) -> Datum:
                 d.encoded = bool(v)
         elif field == 4 and wt == 2:
             ln, pos = _read_varint(buf, pos)
-            d.data = buf[pos : pos + ln]
-            pos += ln
+            d.data, pos = _read_bytes(buf, pos, ln)
         elif field == 6 and wt == 5:
-            d.float_data.append(struct.unpack_from("<f", buf, pos)[0])
-            pos += 4
+            vals, pos = _read_f32s(buf, pos, 1)
+            d.float_data.append(vals[0])
         elif field == 6 and wt == 2:  # packed repeated float
             ln, pos = _read_varint(buf, pos)
             if ln % 4:
                 raise RecordError("bad packed float length")
-            d.float_data.extend(struct.unpack_from(f"<{ln // 4}f", buf, pos))
-            pos += ln
+            vals, pos = _read_f32s(buf, pos, ln // 4)
+            d.float_data.extend(vals)
         else:
             pos = _skip_field(buf, pos, wt)
     return d
@@ -271,8 +287,8 @@ def decode_record(buf: bytes) -> ImageRecord:
             rtype, pos = _read_varint(buf, pos)
         elif field == 2 and wt == 2:
             ln, pos = _read_varint(buf, pos)
-            image = _decode_image(buf[pos : pos + ln])
-            pos += ln
+            sub, pos = _read_bytes(buf, pos, ln)
+            image = _decode_image(sub)
         else:
             pos = _skip_field(buf, pos, wt)
     if rtype != RECORD_TYPE_SINGLE_LABEL_IMAGE:
