@@ -158,6 +158,12 @@ class LMDBReader:
             return None
 
     def _pread(self, off: int, n: int) -> bytes:
+        # bound BEFORE seeking: a corrupt 48-bit page number times the
+        # page size can exceed the OS offset range and make seek() raise
+        # ValueError (fuzz-pinned) — every out-of-file read must be a
+        # clean LMDBError instead
+        if off < 0 or off + n > self._size:
+            raise LMDBError(f"{self.path!r}: truncated read at {off}")
         self._f.seek(off)
         data = self._f.read(n)
         if len(data) < n:
@@ -165,8 +171,7 @@ class LMDBReader:
         return data
 
     def _page(self, pgno: int) -> bytes:
-        if pgno * self.psize >= self._size:
-            raise LMDBError(f"{self.path!r}: page {pgno} beyond EOF")
+        # _pread holds the single authoritative out-of-file bound
         return self._pread(pgno * self.psize, self.psize)
 
     def _iter_page(
@@ -190,23 +195,49 @@ class LMDBReader:
         if nkeys < 0 or lower > self.psize:
             raise LMDBError(f"{self.path!r}: corrupt page {pgno}")
         ptrs = struct.unpack_from(f"<{nkeys}H", page, PAGEHDRSZ)
+
+        def node(off: int):
+            # node offsets are raw u16s out of a possibly-corrupt page:
+            # bound them (and the key bytes they declare) before any
+            # unpack so corruption raises LMDBError, not struct.error
+            if off < PAGEHDRSZ or off + NODEHDRSZ > self.psize:
+                raise LMDBError(
+                    f"{self.path!r}: corrupt node offset {off} in page "
+                    f"{pgno}"
+                )
+            return _NODEHDR.unpack_from(page, off)
+
         if flags & P_BRANCH:
             for off in ptrs:
-                lo, hi, nflags, _ = _NODEHDR.unpack_from(page, off)
+                lo, hi, nflags, _ = node(off)
                 child = lo | (hi << 16) | (nflags << 32)
                 yield from self._iter_page(child, visits, depth + 1)
         elif flags & P_LEAF:
             for off in ptrs:
-                lo, hi, nflags, ksize = _NODEHDR.unpack_from(page, off)
+                lo, hi, nflags, ksize = node(off)
                 if nflags & (F_SUBDATA | F_DUPDATA):
                     raise LMDBError("dupsort/sub-database nodes unsupported")
                 dsize = lo | (hi << 16)
-                key = page[off + NODEHDRSZ : off + NODEHDRSZ + ksize]
                 dstart = off + NODEHDRSZ + ksize
+                if dstart > self.psize:
+                    raise LMDBError(
+                        f"{self.path!r}: corrupt leaf key in page {pgno}"
+                    )
+                key = page[off + NODEHDRSZ : dstart]
                 if nflags & F_BIGDATA:
+                    if dstart + 8 > self.psize:
+                        raise LMDBError(
+                            f"{self.path!r}: corrupt bigdata node in "
+                            f"page {pgno}"
+                        )
                     (ovpgno,) = struct.unpack_from("<Q", page, dstart)
                     yield key, self._read_overflow(ovpgno, dsize)
                 else:
+                    if dstart + dsize > self.psize:
+                        raise LMDBError(
+                            f"{self.path!r}: corrupt leaf value in page "
+                            f"{pgno}"
+                        )
                     yield key, page[dstart : dstart + dsize]
         else:
             raise LMDBError(

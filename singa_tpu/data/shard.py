@@ -31,6 +31,39 @@ def shard_path(folder: str) -> str:
     return os.path.join(folder, "shard.dat")
 
 
+def _read_tuple(f, size: int) -> tuple[bytes, bytes] | None:
+    """One complete [keylen key vallen val] tuple, or None at EOF/torn
+    tail (file position restored). The single copy of the torn-tail
+    arithmetic shared by the reader and the append pre-scan: lengths
+    are bounded against ``size`` BEFORE the read, so a corrupt u64
+    length surfaces as a torn tail, never OverflowError/MemoryError
+    from read() — the native codec applies the same guard
+    (shardcodec.cc). Fuzz-pinned in test_records_fuzz.py."""
+    pos = f.tell()
+    head = f.read(8)
+    if len(head) < 8:
+        f.seek(pos)
+        return None
+    keylen = _LEN.unpack(head)[0]
+    if keylen > size - pos - 8:
+        f.seek(pos)
+        return None
+    key = f.read(keylen)
+    head = f.read(8)
+    if len(key) < keylen or len(head) < 8:
+        f.seek(pos)
+        return None
+    vallen = _LEN.unpack(head)[0]
+    if vallen > size - pos - 16 - keylen:
+        f.seek(pos)
+        return None
+    val = f.read(vallen)
+    if len(val) < vallen:
+        f.seek(pos)
+        return None
+    return key, val
+
+
 class ShardWriter:
     """Create or append a shard (reference modes kCreate / kAppend)."""
 
@@ -48,25 +81,13 @@ class ShardWriter:
 
     def _scan_existing(self) -> int:
         """Scan complete tuples, fill the key set, return the offset after
-        the last complete tuple (PrepareForAppend, shard.cc:175-206)."""
+        the last complete tuple (PrepareForAppend, shard.cc:175-206);
+        torn/corrupt tails stop the scan (_read_tuple)."""
         valid_end = 0
+        size = os.path.getsize(self.path)
         with open(self.path, "rb") as f:
-            while True:
-                head = f.read(8)
-                if len(head) < 8:
-                    break
-                keylen = _LEN.unpack(head)[0]
-                key = f.read(keylen)
-                if len(key) < keylen:
-                    break
-                head = f.read(8)
-                if len(head) < 8:
-                    break
-                vallen = _LEN.unpack(head)[0]
-                val = f.read(vallen)
-                if len(val) < vallen:
-                    break
-                self.keys.add(key)
+            while (kv := _read_tuple(f, size)) is not None:
+                self.keys.add(kv[0])
                 valid_end = f.tell()
         return valid_end
 
@@ -108,26 +129,15 @@ class ShardReader:
             raise ShardError(f"no shard.dat under {folder!r}")
         self._bufsize = buffer_size
         self._f = open(self.path, "rb", buffering=buffer_size)
+        # snapshot the size once: lengths are bounded against it in
+        # next() (anything past the opened snapshot is a torn tail; a
+        # per-record fstat would put a syscall on the training hot path)
+        self._size = os.fstat(self._f.fileno()).st_size
 
     def next(self) -> tuple[bytes, bytes] | None:
-        """Next (key, value), or None at EOF / torn tail."""
-        pos = self._f.tell()
-        head = self._f.read(8)
-        if len(head) < 8:
-            self._f.seek(pos)
-            return None
-        keylen = _LEN.unpack(head)[0]
-        key = self._f.read(keylen)
-        head = self._f.read(8)
-        if len(key) < keylen or len(head) < 8:
-            self._f.seek(pos)
-            return None
-        vallen = _LEN.unpack(head)[0]
-        val = self._f.read(vallen)
-        if len(val) < vallen:
-            self._f.seek(pos)
-            return None
-        return key, val
+        """Next (key, value), or None at EOF / torn tail (_read_tuple
+        holds the shared torn-tail/corrupt-length arithmetic)."""
+        return _read_tuple(self._f, self._size)
 
     def seek_to_first(self) -> None:
         self._f.seek(0)
