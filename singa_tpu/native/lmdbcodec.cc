@@ -198,12 +198,19 @@ struct Walker {
       rc = -3;
       return false;
     }
-    int64_t n = static_cast<int64_t>(d.channels) * d.height * d.width;
+    // overflow-checked C*H*W: corrupt dims must become a clean reject,
+    // not signed-overflow UB or a doomed multi-GB resize below
+    if (d.channels <= 0 || d.height <= 0 || d.width <= 0) {
+      rc = -4;
+      return false;
+    }
+    int64_t n = static_cast<int64_t>(d.channels) * d.height;
+    if (n > INT64_MAX / d.width) {
+      rc = -4;
+      return false;
+    }
+    n *= d.width;
     if (sample < 0) {
-      if (n <= 0) {
-        rc = -4;
-        return false;
-      }
       sample = n;
       shape[0] = d.channels;
       shape[1] = d.height;
@@ -214,21 +221,24 @@ struct Walker {
                 // fallback raises the descriptive error
       return false;
     }
-    size_t old = pixels.size();
-    pixels.resize(old + sample);
-    float* dst = pixels.data() + old;
+    // payload size must match BEFORE the dense arrays grow, so every
+    // resize is bounded by bytes actually present in the file
     if (d.pix_len) {
       if (static_cast<int64_t>(d.pix_len) != sample) {
         rc = -5;
         return false;
       }
+    } else if (static_cast<int64_t>(d.floats.size()) != sample) {
+      rc = -5;
+      return false;
+    }
+    size_t old = pixels.size();
+    pixels.resize(old + sample);
+    float* dst = pixels.data() + old;
+    if (d.pix_len) {
       for (int64_t i = 0; i < sample; ++i)
         dst[i] = static_cast<float>(d.pix[i]);
     } else {
-      if (static_cast<int64_t>(d.floats.size()) != sample) {
-        rc = -5;
-        return false;
-      }
       std::memcpy(dst, d.floats.data(), sample * sizeof(float));
     }
     labels.push_back(d.label);

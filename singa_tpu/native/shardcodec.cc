@@ -272,7 +272,7 @@ int64_t sc_scan(const char* path, uint64_t* valid_end) {
 // uniform-dataset contract — callers fall back to the Python codec).
 int64_t sc_load_dataset_alloc(const char* path, float** pixels_out,
                               int32_t** labels_out, int32_t* shape,
-                              int32_t shape_cap, int32_t* ndim) {
+                              int32_t shape_cap, int32_t* ndim) try {
   FileBuf fb(path);
   if (!fb.ok) return -1;
   std::vector<float> pixels;
@@ -297,29 +297,39 @@ int64_t sc_load_dataset_alloc(const char* path, float** pixels_out,
           *ndim = static_cast<int32_t>(img.shape.size());
           sample = 1;
           for (size_t i = 0; i < img.shape.size(); ++i) {
-            shape[i] = img.shape[i];
-            sample *= img.shape[i];
-          }
-          if (sample <= 0) {
-            rc = -4;
-            return false;
+            int32_t d = img.shape[i];
+            // corrupt dims must fail cleanly: d <= 0 and the
+            // overflow-checked product keep `sample` well-defined
+            // (a fuzzed shape once drove resize() into bad_alloc and
+            // aborted the embedding process before the payload check
+            // below was hoisted above the allocation)
+            if (d <= 0 || sample > INT64_MAX / d) {
+              rc = -4;
+              return false;
+            }
+            shape[i] = d;
+            sample *= d;
           }
         }
-        size_t old = pixels.size();
-        pixels.resize(old + sample);
-        float* dst = pixels.data() + old;
+        // validate the payload size BEFORE growing the dense arrays:
+        // a mismatched record must cost nothing, and after this check
+        // every resize is bounded by bytes actually present on disk
         if (img.pixel_len) {
           if (static_cast<int64_t>(img.pixel_len) != sample) {
             rc = -5;
             return false;
           }
+        } else if (static_cast<int64_t>(img.data.size()) != sample) {
+          rc = -5;
+          return false;
+        }
+        size_t old = pixels.size();
+        pixels.resize(old + sample);
+        float* dst = pixels.data() + old;
+        if (img.pixel_len) {
           for (int64_t i = 0; i < sample; ++i)
             dst[i] = static_cast<float>(img.pixel[i]);
         } else {
-          if (static_cast<int64_t>(img.data.size()) != sample) {
-            rc = -5;
-            return false;
-          }
           std::memcpy(dst, img.data.data(), sample * sizeof(float));
         }
         labels.push_back(img.label);
@@ -341,6 +351,12 @@ int64_t sc_load_dataset_alloc(const char* path, float** pixels_out,
   *pixels_out = p;
   *labels_out = l;
   return static_cast<int64_t>(labels.size());
+} catch (...) {
+  // NO C++ exception may escape the C ABI — it would std::terminate
+  // the embedding Python process (observed: FileBuf fed a directory
+  // path resizes to ftell's bogus LONG_MAX and throws bad_alloc).
+  // Surface as a decode error; callers fall back to the Python codec.
+  return -6;
 }
 
 void sc_free(void* p) { std::free(p); }
