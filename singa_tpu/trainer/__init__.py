@@ -1,7 +1,12 @@
 """Training engine (the reference's worker side, L5)."""
 
 from .cd import CDTrainer
-from .checkpoint import load_checkpoint, restore_into, save_checkpoint
+from .checkpoint import (
+    CheckpointError,
+    load_checkpoint,
+    restore_into,
+    save_checkpoint,
+)
 from .replica import ReplicaTrainer
 from .trainer import Trainer
 
@@ -39,6 +44,7 @@ __all__ = [
     "ReplicaTrainer",
     "make_trainer",
     "save_checkpoint",
+    "CheckpointError",
     "load_checkpoint",
     "restore_into",
 ]

@@ -23,11 +23,22 @@ parsed but never honored. Here they are:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
 
 import jax.numpy as jnp
 import numpy as np
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file is missing, unreadable, or corrupt.
+
+    The atomic-write discipline means a checkpoint singa-tpu wrote is
+    either complete or absent — so corruption implies external damage,
+    and the operator deserves one clear error instead of whatever
+    np.load's zip layer leaks (BadZipFile/KeyError/OSError/...;
+    corruption-probe-pinned in tests)."""
+
 
 _STEP_KEY = "__step__"
 _P = "p|"  # param arrays
@@ -79,8 +90,9 @@ def load_checkpoint(
 ]:
     """-> (step, params, state, buffers). Stream positions via
     load_stream_positions (kept out of this signature for the callers
-    that only want arrays)."""
-    with np.load(path) as z:
+    that only want arrays). Raises CheckpointError on a missing or
+    corrupt file."""
+    with _open_checkpoint(path) as z:
         step = int(z[_STEP_KEY])
         params: dict[str, np.ndarray] = {}
         state: dict[str, dict[str, np.ndarray]] = {}
@@ -96,10 +108,29 @@ def load_checkpoint(
     return step, params, state, buffers
 
 
+@contextlib.contextmanager
+def _open_checkpoint(path: str):
+    """np.load with the CheckpointError policy: one place owns the
+    missing-vs-corrupt distinction for every load path."""
+    try:
+        with np.load(path) as z:
+            yield z
+    except CheckpointError:
+        raise
+    except FileNotFoundError:
+        raise CheckpointError(f"checkpoint not found: {path!r}") from None
+    except Exception as e:
+        raise CheckpointError(
+            f"corrupt or unreadable checkpoint {path!r}: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+
+
 def load_stream_positions(path: str) -> dict[str, int]:
     """-> {"<phase>|<layer>": consumed position} from the checkpoint
-    (empty for checkpoints written before the stream section existed)."""
-    with np.load(path) as z:
+    (empty for checkpoints written before the stream section existed).
+    Raises CheckpointError like load_checkpoint."""
+    with _open_checkpoint(path) as z:
         return {
             key[len(_D):]: int(z[key])
             for key in z.files

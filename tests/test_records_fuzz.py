@@ -340,3 +340,44 @@ def test_native_lmdb_loader_total_under_corruption(tmp_path):
     for blob in _bitflip_corpus(rng, orig, 200):
         db.write_bytes(blob)
         native.load_lmdb_dataset(str(db))  # may reject; must not abort
+
+
+def test_checkpoint_load_raises_checkpoint_error(tmp_path):
+    """Corrupt/missing checkpoints must surface as CheckpointError with
+    the path in the message — not np.load's zip-layer zoo (BadZipFile /
+    KeyError / OSError / NotImplementedError, all observed in a 400-trial
+    bit-flip probe before the wrap)."""
+    import random as _r
+
+    import numpy as np
+
+    from singa_tpu.trainer.checkpoint import (
+        CheckpointError,
+        load_checkpoint,
+        load_stream_positions,
+        save_checkpoint,
+    )
+
+    ck = str(tmp_path / "c.npz")
+    save_checkpoint(ck, 5, {"w": np.ones((3, 3))},
+                    {"w": {"hist": np.zeros(3)}}, {}, {})
+    assert load_checkpoint(ck)[0] == 5
+    orig = open(ck, "rb").read()
+
+    with pytest.raises(CheckpointError, match="not found"):
+        load_checkpoint(str(tmp_path / "missing.npz"))
+
+    rng = _r.Random(0)
+    corrupted = 0
+    for _ in range(150):
+        blob = bytearray(orig)
+        for _ in range(rng.randint(1, 10)):
+            blob[rng.randrange(len(blob))] ^= 1 << rng.randrange(8)
+        open(ck, "wb").write(bytes(blob))
+        for fn in (load_checkpoint, load_stream_positions):
+            try:
+                fn(ck)
+            except CheckpointError as e:
+                assert "c.npz" in str(e)
+                corrupted += 1
+    assert corrupted > 50  # the corpus must actually hit the error path

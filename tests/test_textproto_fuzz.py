@@ -168,3 +168,51 @@ def test_realistic_depth_still_parses():
     for _ in range(50):
         (msg,) = msg["a"]
     assert msg == {"x": [1]}
+
+
+def test_schema_layer_total_on_mutated_confs():
+    """The typed schema layer over mutated REAL confs (field renames,
+    deletions, token injections into mlp.conf) may only raise
+    TextProtoError/ConfigError — never KeyError/AttributeError from an
+    unvalidated access path."""
+    import os
+
+    from singa_tpu.config.schema import ConfigError, parse_model_config
+
+    import re
+
+    conf = os.path.join(os.path.dirname(__file__), "..",
+                        "examples", "mnist", "mlp.conf")
+    # strip comments BEFORE tokenizing: space-joined tokens would
+    # otherwise all land behind the conf's first '#' and every trial
+    # would vacuously parse an empty message
+    text = re.sub(r"#[^\n]*", "", open(conf).read())
+    tokens = text.split()
+    # the pristine stripped conf must parse (guards this test against
+    # becoming vacuous again)
+    assert parse_model_config(" ".join(tokens)).neuralnet is not None
+
+    rng = random.Random(4)
+    junk = ["{", "}", ":", "xyz", '"q"', "3.5", "-7", "true", "kFoo"]
+    survived = 0
+    for _ in range(500):
+        toks = list(tokens)
+        for _ in range(rng.randint(1, 6)):
+            i = rng.randrange(len(toks))
+            op = rng.randrange(4)
+            if op == 0:
+                toks[i] = rng.choice(junk)
+            elif op == 1:
+                del toks[i]
+            elif op == 2:
+                toks.insert(i, rng.choice(junk[:5]))
+            else:
+                toks[i] = toks[i][::-1]
+        try:
+            parse_model_config(" ".join(toks))
+            survived += 1
+        except (TextProtoError, ConfigError):
+            pass
+    # some mutations must survive to a parsed config AND some must
+    # error — both schema acceptance and rejection paths exercised
+    assert 0 < survived < 500, survived
