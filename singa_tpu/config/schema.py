@@ -47,6 +47,17 @@ LR_CHANGE_METHODS = (
     "kStep",
 )
 
+#: Accepted alternate spellings, normalized to the reference token before
+#: enum membership is checked. The reference's model.proto misspells
+#: Gaussian ("kGaussain", model.proto:75); hand-written configs using the
+#: corrected spelling parse fine and normalize to the [sic] token so the
+#: rest of the system (param init, checkpoints) sees one vocabulary.
+#: netlint's CFG003 points authors at this table.
+ENUM_ALIASES = {
+    "kGaussian": "kGaussain",
+    "kGaussianSqrtFanIn": "kGaussainSqrtFanIn",
+}
+
 
 class ConfigError(ValueError):
     pass
@@ -109,11 +120,17 @@ class Field:
                 raise ConfigError(f"field {name!r} expects a string, got {raw!r}")
             return raw
         if k == "enum":
-            if not isinstance(raw, str) or raw not in self.enum:
+            if isinstance(raw, str) and raw in self.enum:
+                # exact members always win; aliasing only rescues
+                # spellings the vocabulary does not contain
+                return raw
+            canon = ENUM_ALIASES.get(raw, raw) if isinstance(raw, str) else raw
+            if not isinstance(raw, str) or canon not in self.enum:
+                # report what the user wrote, not the normalized token
                 raise ConfigError(
                     f"field {name!r}: {raw!r} not in enum {self.enum}"
                 )
-            return raw
+            return canon
         raise AssertionError(k)
 
 

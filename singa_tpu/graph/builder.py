@@ -54,7 +54,10 @@ def topo_sort(configs: list[LayerConfig]) -> list[LayerConfig]:
         order.append(cur)
         for c in configs:
             if cur.name in c.srclayers:
-                indeg[c.name] -= 1
+                # per-occurrence: a layer may list the same src twice
+                # (e.g. concat of a layer with itself); indeg counted
+                # every edge, so remove every edge
+                indeg[c.name] -= c.srclayers.count(cur.name)
                 if indeg[c.name] == 0:
                     ready.append(c)
     if len(order) != len(configs):
@@ -278,6 +281,21 @@ class Net:
             for src in l.srclayers
         ]
         return {"phase": self.phase, "nodes": nodes, "links": links}
+
+
+def active_phases(model_cfg: ModelConfig) -> list[str]:
+    """Phases this job actually builds nets for (Trainer.__init__ builds
+    from this list): kTrain always, kTest/kValidation only when their
+    step counts are set.
+    Lint passes check exactly these — a conf whose two ``data`` layers
+    exclude kTrain/kTest respectively is fine unless validation_steps
+    makes the kValidation net (where both would be live) real."""
+    phases = ["kTrain"]
+    if model_cfg.test_steps:
+        phases.append("kTest")
+    if model_cfg.validation_steps:
+        phases.append("kValidation")
+    return phases
 
 
 def filter_phase(net_cfg: NetConfig, phase: str) -> list[LayerConfig]:

@@ -1,0 +1,37 @@
+"""netlint: static config/graph/sharding validation + JAX-hazard lint.
+
+Two pass families (ROADMAP "correctness tooling"):
+
+1. **Net/config passes** (``net_rules``, ``shape_rules``) validate parsed
+   job confs without executing anything: schema spellings with
+   did-you-mean, dangling/cyclic ``srclayers``, phase-exclusion breaks,
+   abstract shape/dtype propagation via ``jax.eval_shape``, param sharing,
+   and GSPMD divisibility (the statically-decidable sharding errors).
+2. **AST passes** (``ast_rules``) lint Python source for JAX hazards:
+   host syncs and Python branches inside jitted code, missing
+   ``donate_argnums`` on the train-step path, untyped array literals.
+
+CLI: ``python -m singa_tpu.tools.lint <job.conf | dir> [--cluster F]``;
+``--self`` lints this package's own source (wired into CI). Rule codes,
+severities, and suppression are documented in README "Static analysis
+(netlint)" and by ``--list-rules``.
+"""
+
+from .core import (  # noqa: F401
+    Collector,
+    Diagnostic,
+    ERROR,
+    INFO,
+    RULES,
+    WARNING,
+    render_json,
+    render_rule_table,
+    render_text,
+)
+from .net_rules import (  # noqa: F401
+    lint_cluster_text,
+    lint_model_text,
+    sharding_rules_static,
+)
+from .shape_rules import shape_pass  # noqa: F401
+from .ast_rules import lint_python_file, lint_python_tree  # noqa: F401

@@ -1,0 +1,394 @@
+"""netlint pass family 2: AST lint for JAX hazards (stdlib ``ast`` only).
+
+The graph passes check *configs*; these check *code*. JAX's failure modes
+are unusually lintable: a host sync (``float()`` / ``.item()`` /
+``np.asarray``) on a tracer raises ConcretizationTypeError only when the
+jitted path actually runs, a Python ``if`` on a tracer fails the same way,
+a forgotten ``donate_argnums`` on the train step silently doubles peak
+memory, and an untyped ``jnp.array`` literal can retrigger compilation via
+weak-type promotion. All four are visible in the source.
+
+Scope heuristic (documented, deliberately conservative): JAX001/JAX002
+only fire inside functions this pass can *prove* are jitted — decorated
+with ``@jax.jit`` (directly or through ``partial``), or passed by name to
+a ``jax.jit(...)`` call in the same file. Helpers traced indirectly are
+not scanned; zero false positives beats exhaustive coverage for an
+ERROR-severity rule, and CI runs this over ``singa_tpu/`` itself.
+
+Per-line suppression: ``# netlint: disable=JAX003`` (comma-separate
+codes, or omit ``=...`` to silence every rule on that line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Collector, ERROR, WARNING, rule
+
+JAX000 = rule("JAX000", ERROR, "python file does not parse")
+JAX001 = rule(
+    "JAX001", ERROR, "host sync on a traced value inside jitted code"
+)
+JAX002 = rule(
+    "JAX002",
+    WARNING,
+    "Python branch on a tracer-valued expression inside jitted code",
+)
+JAX003 = rule(
+    "JAX003",
+    WARNING,
+    "jax.jit on the trainer path without donate_argnums",
+)
+JAX004 = rule(
+    "JAX004",
+    WARNING,
+    "untyped jnp.array literal (weak-type recompilation hazard)",
+)
+JAX005 = rule(
+    "JAX005",
+    WARNING,
+    "numpy conversion inside jitted code (host round-trip)",
+)
+
+# the code list stops at the first non-code token, so trailing prose
+# ("# netlint: disable=JAX003 TODO revisit") cannot corrupt the set
+_SUPPRESS_RE = re.compile(
+    r"#\s*netlint:\s*disable(?:=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?"
+)
+
+#: directories no lint walk descends into — shared by lint_python_tree
+#: and the CLI's path collector so `lint <dir>` and `lint --self` agree
+#: on what gets scanned
+PRUNE_DIRS = frozenset({"__pycache__", ".git"})
+
+#: numpy module aliases whose array constructors force a device->host copy
+_HOST_NP = ("np", "numpy", "onp")
+
+
+def _suppressions(source: str) -> dict[int, set[str] | None]:
+    """lineno -> suppressed codes (None = all)."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            codes = m.group(1)
+            out[i] = (
+                {c.strip() for c in codes.split(",") if c.strip()}
+                if codes
+                else None
+            )
+    return out
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    """Matches ``jax.jit`` or bare ``jit``."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_decorator(dec: ast.expr) -> ast.Call | None:
+    """-> the configuring Call for a jit decorator (for kwarg checks), or
+    a synthetic marker for the bare form. Handles ``@jax.jit``,
+    ``@jax.jit(...)``, and ``@(functools.)partial(jax.jit, ...)``."""
+    if _is_jax_jit(dec):
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Call):
+        if _is_jax_jit(dec.func):
+            return dec
+        func = dec.func
+        is_partial = (
+            isinstance(func, ast.Name) and func.id == "partial"
+        ) or (isinstance(func, ast.Attribute) and func.attr == "partial")
+        if is_partial and dec.args and _is_jax_jit(dec.args[0]):
+            return dec
+    return None
+
+
+def _contains_jnp(node: ast.AST) -> bool:
+    """Does the expression mention ``jnp.<anything>``? Used as the
+    tracer-valued marker: jnp calls on static Python values are rare."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "jnp"
+        ):
+            return True
+    return False
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    return False
+
+
+class _FileLinter:
+    def __init__(self, path: str, source: str, col: Collector):
+        self.path = path
+        self.col = col
+        self.suppress = _suppressions(source)
+        # JAX003 scope: only components at/under the package root count
+        # (else a checkout under e.g. /home/trainer/ would flag every
+        # module); outside a singa_tpu tree, judge by dir + filename only
+        parts = path.replace(os.sep, "/").split("/")
+        if "singa_tpu" in parts:
+            parts = parts[parts.index("singa_tpu") :]
+        else:
+            parts = parts[-2:]
+        self.on_trainer_path = any("trainer" in p for p in parts)
+
+    def emit(
+        self,
+        r,
+        node: ast.AST,
+        msg: str,
+        *,
+        fix_hint: str = "",
+        severity: str | None = None,
+        end_line: int | None = None,
+    ) -> None:
+        # a multi-line construct may carry the disable comment on any of
+        # its lines (black puts it after the closing paren). Block
+        # statements pass end_line to stop at their header — a comment
+        # deep in an if-body must not suppress the enclosing finding.
+        if end_line is None:
+            end_line = getattr(node, "end_lineno", None) or node.lineno
+        for line in range(node.lineno, end_line + 1):
+            sup = self.suppress.get(line, "unset")
+            if sup is None or (sup != "unset" and r.code in sup):
+                return
+        self.col.emit(
+            r,
+            f"{self.path}:{node.lineno}:{node.col_offset}",
+            msg,
+            fix_hint=fix_hint,
+            severity=severity,
+        )
+
+    # ---------------- jitted-context discovery ----------------
+
+    def jitted_functions(self, tree: ast.Module) -> list[ast.AST]:
+        # ``jax.jit(name)`` resolves the bare name LEXICALLY: only defs
+        # whose enclosing scope is an ancestor of the call site count
+        # (defs in class bodies: the class body itself only). A flat
+        # name-match would scan a never-jitted host helper that happens
+        # to share a name with a jitted closure in a sibling method —
+        # a false ERROR this pass's contract forbids.
+        defs: dict[str, list[tuple[ast.AST, tuple, bool]]] = {}
+        jit_calls: list[tuple[ast.Call, tuple]] = []
+
+        def walk(node: ast.AST, path: tuple) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    defs.setdefault(child.name, []).append(
+                        (child, path, isinstance(node, ast.ClassDef))
+                    )
+                    walk(child, path + (id(child),))
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, path + (id(child),))
+                else:
+                    if (
+                        isinstance(child, ast.Call)
+                        and _is_jax_jit(child.func)
+                        and child.args
+                        and isinstance(child.args[0], ast.Name)
+                    ):
+                        jit_calls.append((child, path))
+                    walk(child, path)
+
+        walk(tree, ())
+        jitted: list[ast.AST] = []
+        seen: set[int] = set()
+
+        def add(fn: ast.AST) -> None:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                jitted.append(fn)
+
+        for entries in defs.values():
+            for fn, _, _ in entries:
+                if any(_jit_decorator(d) for d in fn.decorator_list):
+                    add(fn)
+        for call, cpath in jit_calls:
+            for fn, dpath, in_class in defs.get(call.args[0].id, []):
+                visible = (
+                    dpath == cpath
+                    if in_class
+                    else dpath == cpath[: len(dpath)]
+                )
+                if visible:
+                    add(fn)
+        return jitted
+
+    # ---------------- rules ----------------
+
+    def check_jitted_body(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._host_sync_rules(node)
+            elif isinstance(node, (ast.If, ast.While)):
+                if _contains_jnp(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    self.emit(
+                        JAX002,
+                        node,
+                        f"Python `{kind}` on a jnp-valued expression "
+                        "inside jitted code traces only one branch",
+                        fix_hint="use jnp.where / lax.cond / lax.select",
+                        end_line=getattr(
+                            node.test, "end_lineno", None
+                        )
+                        or node.lineno,
+                    )
+
+    def _host_sync_rules(self, node: ast.Call) -> None:
+        func = node.func
+        # x.item() — device sync + concretization error under trace
+        if isinstance(func, ast.Attribute) and func.attr == "item":
+            self.emit(
+                JAX001,
+                node,
+                ".item() inside jitted code concretizes a tracer",
+                fix_hint="return the array and read it outside the jit",
+            )
+            return
+        # float(<jnp expr>) / int(<jnp expr>)
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int", "bool")
+            and node.args
+            and _contains_jnp(node.args[0])
+        ):
+            self.emit(
+                JAX001,
+                node,
+                f"{func.id}() on a jnp expression inside jitted code "
+                "concretizes a tracer",
+                fix_hint="keep the value as a jnp array inside the jit",
+            )
+            return
+        # np.asarray / np.array on a non-literal — host round-trip. Its
+        # own WARNING code (not JAX001): the argument may turn out to be
+        # a static Python value, so ERROR would risk false positives.
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("asarray", "array")
+            and isinstance(func.value, ast.Name)
+            and func.value.id in _HOST_NP
+            and node.args
+            and not _is_literal(node.args[0])
+        ):
+            self.emit(
+                JAX005,
+                node,
+                f"{func.value.id}.{func.attr}() inside jitted code pulls "
+                "the value to the host",
+                fix_hint="use jnp, or hoist the conversion out of the jit",
+            )
+
+    def check_jit_callsites(self, tree: ast.Module) -> None:
+        """JAX003: train-path jit without donation. Only meaningful where
+        step inputs are dead after the call — i.e. trainer modules."""
+        if not self.on_trainer_path:
+            return
+
+        def check(kwargs: set, node: ast.AST) -> None:
+            if not kwargs & {"donate_argnums", "donate_argnames"}:
+                self.emit(
+                    JAX003,
+                    node,
+                    "jax.jit without donate_argnums on the trainer path "
+                    "keeps both input and output buffers live",
+                    fix_hint="donate dead step inputs, or suppress with "
+                    "# netlint: disable=JAX003 where inputs are reused",
+                )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                check({kw.arg for kw in node.keywords}, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorator forms the Call branch can't see: bare
+                # @jax.jit and @partial(jax.jit, ...). @jax.jit(...) IS
+                # an ast.Call, so the branch above already covers it.
+                for dec in node.decorator_list:
+                    cfg = _jit_decorator(dec)
+                    if cfg is None or (
+                        isinstance(dec, ast.Call)
+                        and _is_jax_jit(dec.func)
+                    ):
+                        continue
+                    check({kw.arg for kw in cfg.keywords}, dec)
+
+    def check_array_literals(self, tree: ast.Module) -> None:
+        """JAX004: ``jnp.array(<literal>)`` without dtype= is weakly
+        typed — inside a jit it can retrigger compilation and silently
+        change promotion."""
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr == "array"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "jnp"
+            ):
+                continue
+            if not (node.args and _is_literal(node.args[0])):
+                continue
+            # dtype may be passed as keyword or as the second positional
+            if len(node.args) >= 2 or any(
+                kw.arg == "dtype" for kw in node.keywords
+            ):
+                continue
+            self.emit(
+                JAX004,
+                node,
+                "jnp.array on a bare literal is weakly typed",
+                fix_hint="pass dtype= explicitly",
+            )
+
+def lint_python_file(path: str, col: Collector) -> None:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        # one unreadable file must not abort the rest of the run
+        col.emit(JAX000, f"{path}:0:0", f"cannot read: {e}")
+        return
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        col.emit(
+            JAX000,
+            f"{path}:{e.lineno or 0}:0",
+            f"file does not parse: {e.msg}",
+        )
+        return
+    linter = _FileLinter(path, source, col)
+    for fn in linter.jitted_functions(tree):
+        linter.check_jitted_body(fn)
+    linter.check_jit_callsites(tree)
+    linter.check_array_literals(tree)
+
+
+def lint_python_tree(root: str, col: Collector) -> int:
+    """Lint every .py under ``root``; returns the file count."""
+    n = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                lint_python_file(os.path.join(dirpath, fname), col)
+                n += 1
+    return n

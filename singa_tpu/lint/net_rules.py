@@ -1,0 +1,487 @@
+"""netlint pass family 1: config + graph + sharding rules.
+
+These run on the *parsed text*, never executing a layer: a raw-tree walk
+(every-error-at-once schema checking with did-you-mean), then graph rules
+over the typed ``ModelConfig`` (the static half of what
+NeuralNet::ConstructNeuralNet would crash on at runtime, reference
+src/worker/neuralnet.cc:72-110), then cluster-topology and sharding
+divisibility checks (the statically-decidable slice of GSPMD layout,
+parallel/shardings.py).
+
+Sharding rules need a cluster conf to know the mesh axis widths; model-only
+runs skip them. Shape inference (which needs the data sources) lives in
+``shape_rules``.
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+from typing import Any
+
+from ..config import schema, textproto
+from ..config.schema import (
+    ClusterConfig,
+    ConfigError,
+    Message,
+    ModelConfig,
+)
+from ..graph.builder import active_phases
+from .core import Collector, ERROR, INFO, WARNING, rule
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+CFG000 = rule("CFG000", ERROR, "config does not parse (syntax or schema)")
+CFG001 = rule("CFG001", ERROR, "unknown field name (with did-you-mean)")
+CFG002 = rule("CFG002", ERROR, "unknown enum value (with did-you-mean)")
+CFG003 = rule(
+    "CFG003",
+    INFO,
+    "reference [sic] spelling kGaussain; corrected kGaussian is accepted",
+)
+NET001 = rule("NET001", ERROR, "srclayers edge references an unknown layer")
+NET002 = rule("NET002", ERROR, "cycle in the layer graph")
+NET003 = rule(
+    "NET003", ERROR, "live layer depends on a layer excluded from its phase"
+)
+NET004 = rule("NET004", ERROR, "duplicate layer names live in one phase")
+CLU001 = rule(
+    "CLU001", ERROR, "nprocs_per_group not divisible by nseq*nexperts*npipes"
+)
+CLU002 = rule("CLU002", ERROR, "nworkers < nprocs_per_group: zero groups")
+SHD001 = rule(
+    "SHD001",
+    WARNING,
+    "kLayerPartition neuron dim not divisible by the model axis "
+    "(storage is padded / experts replicate instead of sharding)",
+)
+SHD003 = rule(
+    "SHD003", WARNING, "batchsize not divisible by the data axis width"
+)
+
+#: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
+_TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
+
+
+# ---------------------------------------------------------------------------
+# loose schema walk: report every unknown field / enum value, don't fail-fast
+# ---------------------------------------------------------------------------
+
+
+def _line_of(text: str | None, needle: str) -> str:
+    """Best-effort line locator (the textproto parser keeps no positions):
+    first line containing ``needle`` as a whole token — a bare substring
+    scan would attribute 'kGaussain' to a line holding
+    'kGaussainSqrtFanIn'. Falls back to substring if no token match."""
+    if not text:
+        return ""
+    token = re.compile(
+        rf"(?<![A-Za-z0-9_]){re.escape(needle)}(?![A-Za-z0-9_])"
+    )
+    fallback = ""
+    for i, line in enumerate(text.splitlines(), 1):
+        if token.search(line):
+            return str(i)
+        if not fallback and needle in line:
+            fallback = str(i)
+    return fallback
+
+
+def _loc(path: str, text: str | None, needle: str, ctx: str) -> str:
+    line = _line_of(text, needle)
+    base = f"{path}:{line}" if line else path
+    return f"{base} ({ctx})" if ctx else base
+
+
+def walk_raw_config(
+    raw: dict[str, list[Any]],
+    cls: type[Message],
+    path: str,
+    col: Collector,
+    *,
+    text: str | None = None,
+    ctx: str = "",
+    _seen_typos: set[tuple[str, str]] | None = None,
+) -> None:
+    """Check a textproto parse tree against ``cls``'s field schema,
+    emitting CFG001/CFG002/CFG003 for everything wrong (the strict
+    ``Message.from_fields`` stops at the first error; lint wants all).
+    CFG003 is advisory, so it fires once per (field, spelling) per file
+    rather than once per occurrence."""
+    if _seen_typos is None:
+        _seen_typos = set()
+    for fname, occurrences in raw.items():
+        spec = cls.FIELDS.get(fname)
+        if spec is None:
+            close = difflib.get_close_matches(fname, cls.FIELDS, n=1)
+            hint = f"did you mean {close[0]!r}?" if close else ""
+            col.emit(
+                CFG001,
+                _loc(path, text, fname, ctx),
+                f"unknown field {fname!r} in {cls.__name__}",
+                fix_hint=hint,
+            )
+            continue
+        if spec.kind == "message":
+            dicts = [occ for occ in occurrences if isinstance(occ, dict)]
+            if len(dicts) < len(occurrences):
+                col.emit(
+                    CFG000,
+                    _loc(path, text, fname, ctx),
+                    f"field {fname!r} expects a message block",
+                )
+            if not spec.repeated and len(dicts) > 1:
+                # protobuf text-format merge (schema.from_fields): walk
+                # the merged tree once, so a required subfield present in
+                # any occurrence is not misreported as missing
+                merged: dict[str, list[Any]] = {}
+                for occ in dicts:
+                    for sub, subvals in occ.items():
+                        merged.setdefault(sub, []).extend(subvals)
+                dicts = [merged]
+            for occ in dicts:
+                sub_ctx = fname
+                names = occ.get("name")
+                if names and isinstance(names[-1], str):
+                    sub_ctx = f"{fname} {names[-1]!r}"
+                if ctx:
+                    sub_ctx = f"{ctx}.{sub_ctx}"
+                walk_raw_config(
+                    occ,
+                    spec.message,
+                    path,
+                    col,
+                    text=text,
+                    ctx=sub_ctx,
+                    _seen_typos=_seen_typos,
+                )
+        elif spec.kind == "enum":
+            for occ in occurrences:
+                if not isinstance(occ, str):
+                    continue
+                if occ in spec.enum and occ not in _TYPO_NOTES:
+                    continue  # exact member, nothing to say
+                if occ in _TYPO_NOTES and occ in spec.enum:
+                    # a [sic] token used where it is actually valid: note
+                    # the corrected spelling. Used in the WRONG field it
+                    # falls through to the CFG002 membership check below.
+                    if (fname, occ) not in _seen_typos:
+                        _seen_typos.add((fname, occ))
+                        col.emit(
+                            CFG003,
+                            _loc(path, text, occ, ""),
+                            f"{fname}: {occ!r} is the reference's [sic] "
+                            f"spelling; the corrected {_TYPO_NOTES[occ]!r} "
+                            "is accepted as an alias",
+                        )
+                    continue
+                canonical = schema.ENUM_ALIASES.get(occ, occ)
+                if canonical not in spec.enum:
+                    vocab = list(spec.enum) + [
+                        a
+                        for a, t in schema.ENUM_ALIASES.items()
+                        if t in spec.enum
+                    ]
+                    close = difflib.get_close_matches(occ, vocab, n=1)
+                    hint = f"did you mean {close[0]!r}?" if close else ""
+                    col.emit(
+                        CFG002,
+                        _loc(path, text, occ, ctx),
+                        f"{fname}: {occ!r} not in {spec.enum}",
+                        fix_hint=hint,
+                    )
+        else:
+            # scalar kinds: report every coercion failure with the exact
+            # text the strict parse would use (it stops at the first; the
+            # caller dedups by message)
+            for occ in occurrences:
+                try:
+                    spec.convert(occ, fname)
+                except ConfigError as e:
+                    col.emit(CFG000, _loc(path, text, str(occ), ctx), str(e))
+    for fname, spec in cls.FIELDS.items():
+        if (
+            spec.required
+            and not spec.repeated
+            and spec.default is None
+            and fname not in raw
+        ):
+            col.emit(
+                CFG000,
+                f"{path} ({ctx})" if ctx else path,
+                f"{cls.__name__}: missing required {fname!r}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# graph rules (typed ModelConfig)
+# ---------------------------------------------------------------------------
+
+
+def graph_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
+    """NET001-NET004 over every phase the job will actually build."""
+    net_cfg = model_cfg.neuralnet
+    if net_cfg is None:
+        col.emit(CFG000, path, "model config has no neuralnet block")
+        return
+    layers = net_cfg.layer
+    global_names = {l.name for l in layers}
+    seen_dangling: set[tuple[str, str]] = set()
+    seen_cycles: set[frozenset] = set()
+    for phase in active_phases(model_cfg):
+        live = [l for l in layers if phase not in (l.exclude or [])]
+        names = [l.name for l in live]
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        for name in dupes:
+            col.emit(
+                NET004,
+                f"{path} (layer {name!r})",
+                f"{len([n for n in names if n == name])} layers named "
+                f"{name!r} are all live in phase {phase}",
+                fix_hint="add exclude: so at most one survives each "
+                "phase the job runs",
+            )
+        live_names = set(names)
+        for l in live:
+            for src in l.srclayers:
+                if src not in global_names:
+                    if (l.name, src) not in seen_dangling:
+                        seen_dangling.add((l.name, src))
+                        close = difflib.get_close_matches(
+                            src, sorted(global_names), n=1
+                        )
+                        hint = (
+                            f"did you mean {close[0]!r}?" if close else ""
+                        )
+                        col.emit(
+                            NET001,
+                            f"{path} (layer {l.name!r})",
+                            f"srclayers references unknown layer {src!r}",
+                            fix_hint=hint,
+                        )
+                elif src not in live_names:
+                    col.emit(
+                        NET003,
+                        f"{path} (layer {l.name!r})",
+                        f"depends on {src!r}, which is excluded from "
+                        f"phase {phase} while {l.name!r} is live",
+                        fix_hint=f"exclude {l.name!r} from {phase} too, "
+                        f"or un-exclude {src!r}",
+                    )
+        if dupes:
+            continue  # cycle check is ill-defined with duplicate names
+        stuck = _cycle_members(live, live_names)
+        if stuck and frozenset(stuck) not in seen_cycles:
+            seen_cycles.add(frozenset(stuck))
+            col.emit(
+                NET002,
+                path,
+                f"cycle in the layer graph involving {sorted(stuck)} "
+                f"(phase {phase})",
+            )
+
+
+def _cycle_members(live, live_names) -> set[str]:
+    """Kahn's algorithm residue = the layers on (or downstream of) a
+    cycle; dangling edges are ignored (NET001 owns those)."""
+    indeg = {
+        l.name: sum(1 for s in l.srclayers if s in live_names) for l in live
+    }
+    ready = [l for l in live if indeg[l.name] == 0]
+    done = 0
+    while ready:
+        cur = ready.pop()
+        done += 1
+        for l in live:
+            if cur.name in l.srclayers:
+                # per-occurrence, like builder.topo_sort: duplicate
+                # edges are counted in indeg, so remove them all
+                indeg[l.name] -= l.srclayers.count(cur.name)
+                if indeg[l.name] == 0:
+                    ready.append(l)
+    if done == len(live):
+        return set()
+    return {name for name, d in indeg.items() if d > 0}
+
+
+# ---------------------------------------------------------------------------
+# cluster rules
+# ---------------------------------------------------------------------------
+
+
+def cluster_rules(
+    cluster_cfg: ClusterConfig, path: str, col: Collector
+) -> dict[str, int] | None:
+    """CLU001/CLU002; returns the mesh axis widths when the topology is
+    coherent (the sharding rules' input), else None. Both checks run —
+    a conf broken in both ways gets both diagnostics in one pass."""
+    ngroups_err = None
+    try:
+        cluster_cfg.ngroups
+    except ConfigError as e:
+        ngroups_err = str(e)
+        col.emit(CLU002, path, ngroups_err)
+    try:
+        widths = cluster_cfg.axis_widths
+    except ConfigError as e:
+        # axis_widths re-raises the ngroups error when only that one
+        # exists; don't report it under two codes
+        if str(e) != ngroups_err:
+            col.emit(CLU001, path, str(e))
+        return None
+    return None if ngroups_err else widths
+
+
+# ---------------------------------------------------------------------------
+# sharding rules (model conf x cluster axis widths)
+# ---------------------------------------------------------------------------
+
+#: config-declared neuron-dim per layer type, for the static SHD001
+#: fallback when the net can't be built (data sources absent). The
+#: build-based check in shape_rules covers every param precisely.
+_NEURON_DIM_FIELDS = {
+    "kInnerProduct": ("inner_product_param", "num_output"),
+    "kDense": ("dense_param", "num_output"),
+    "kConvolution": ("convolution_param", "num_filters"),
+    "kRBM": ("rbm_param", "num_hidden"),
+}
+
+
+def sharding_rules_static(
+    model_cfg: ModelConfig,
+    widths: dict[str, int],
+    path: str,
+    col: Collector,
+    *,
+    neuron_dims: bool = True,
+) -> None:
+    """SHD001/SHD003 from config fields alone (no data, no layer setup).
+
+    Mirrors parallel/shardings._param_layout's divisibility condition: a
+    kLayerPartition layer whose neuron dim is not a multiple of the model
+    axis gets padded storage (experts: replication) instead of an even
+    shard — legal, but a silent perf/memory cliff worth a warning.
+
+    ``neuron_dims=False`` keeps only the SHD003 batch check — used when
+    the net built and _sharding_rules_built already covered every param
+    precisely (the config-level SHD001 heuristic would double-report).
+    """
+    net_cfg = model_cfg.neuralnet
+    if net_cfg is None:
+        return
+    nmodel = widths.get("model", 1)
+    ndata = widths.get("data", 1)
+    for l in net_cfg.layer:
+        ptype = l.partition_type or net_cfg.partition_type
+        if neuron_dims and nmodel > 1 and ptype == "kLayerPartition":
+            fields = _NEURON_DIM_FIELDS.get(l.type)
+            if fields:
+                sub = getattr(l, fields[0], None)
+                dim = getattr(sub, fields[1], None) if sub else None
+                if dim and dim % nmodel:
+                    col.emit(
+                        SHD001,
+                        f"{path} (layer {l.name!r})",
+                        f"neuron dim {dim} ({fields[1]}) not divisible by "
+                        f"model axis {nmodel}: storage pads to "
+                        f"{dim + (-dim % nmodel)} rather than sharding "
+                        "evenly",
+                        fix_hint=f"pick a multiple of {nmodel} or widen "
+                        "the data axis instead",
+                    )
+        if ndata > 1 and l.data_param is not None and l.data_param.batchsize:
+            bs = l.data_param.batchsize
+            if bs % ndata:
+                col.emit(
+                    SHD003,
+                    f"{path} (layer {l.name!r})",
+                    f"batchsize {bs} not divisible by data axis {ndata}",
+                    fix_hint=f"use a multiple of {ndata}",
+                )
+
+
+_UNKNOWN_FIELD = re.compile(r"unknown field '([^']+)'")
+_BAD_ENUM = re.compile(r"field '[^']+': ('[^']+') not in enum")
+
+
+def _walk_explains(err_msg: str, walk_diags: list) -> bool:
+    """Whether the strict parser's ConfigError re-states a problem the raw
+    walk already reported. The walk validates field names (CFG001), enum
+    membership (CFG002), scalar coercion and required fields (CFG000, with
+    the strict parser's exact message text); only a strict-parse failure
+    matching none of those is new information. Matching is per-problem,
+    never "the walk found *something*" — the strict parse stops at its
+    first error, so suppressing on unrelated findings would hide it."""
+    m = _UNKNOWN_FIELD.search(err_msg)
+    if m:
+        needle = f"unknown field '{m.group(1)}'"
+        return any(
+            d.code == "CFG001" and needle in d.msg for d in walk_diags
+        )
+    m = _BAD_ENUM.search(err_msg)
+    if m:
+        needle = f"{m.group(1)} not in"
+        return any(
+            d.code == "CFG002" and needle in d.msg for d in walk_diags
+        )
+    return any(d.msg == err_msg for d in walk_diags)
+
+
+def lint_model_text(
+    text: str,
+    path: str,
+    col: Collector,
+    *,
+    widths: dict[str, int] | None = None,
+    raw: dict[str, list[Any]] | None = None,
+) -> ModelConfig | None:
+    """Full static pass over one model conf: raw walk, strict parse,
+    graph rules, static sharding rules. Returns the parsed config when it
+    parsed (the shape pass builds on it), else None. Pass ``raw`` when
+    the caller already parsed the text (the CLI does, to classify
+    model vs cluster confs)."""
+    if raw is None:
+        try:
+            raw = textproto.parse(text)
+        except textproto.TextProtoError as e:
+            col.emit(CFG000, path, str(e))
+            return None
+    before = len(col.diagnostics)
+    walk_raw_config(raw, ModelConfig, path, col, text=text)
+    try:
+        model_cfg = ModelConfig.from_fields(raw)
+    except ConfigError as e:
+        if not _walk_explains(str(e), col.diagnostics[before:]):
+            col.emit(CFG000, path, str(e))
+        return None
+    graph_rules(model_cfg, path, col)
+    if widths:
+        sharding_rules_static(model_cfg, widths, path, col)
+    return model_cfg
+
+
+def lint_cluster_text(
+    text: str,
+    path: str,
+    col: Collector,
+    *,
+    raw: dict[str, list[Any]] | None = None,
+) -> tuple[ClusterConfig | None, dict[str, int] | None]:
+    """Static pass over one cluster conf; returns (config, axis widths)."""
+    if raw is None:
+        try:
+            raw = textproto.parse(text)
+        except textproto.TextProtoError as e:
+            col.emit(CFG000, path, str(e))
+            return None, None
+    before = len(col.diagnostics)
+    walk_raw_config(raw, ClusterConfig, path, col, text=text)
+    try:
+        cluster_cfg = ClusterConfig.from_fields(raw)
+    except ConfigError as e:
+        if not _walk_explains(str(e), col.diagnostics[before:]):
+            col.emit(CFG000, path, str(e))
+        return None, None
+    return cluster_cfg, cluster_rules(cluster_cfg, path, col)

@@ -1,0 +1,219 @@
+"""netlint pass family 1b: build-based shape/dtype + param checks.
+
+These go one step deeper than ``net_rules``: the net is actually built
+(layer ``setup`` = shape inference, exactly what the worker would run) and
+the whole forward pass is traced *abstractly* with ``jax.eval_shape`` — no
+FLOP executes, no device memory is touched, but every dot-product dimension
+mismatch, dtype surprise, or broken layer contract in the traced path
+surfaces as a diagnostic instead of a crash minutes into a pod job.
+
+Building a net opens its data sources (data layers learn their sample
+shape from the first record, reference layer.cc:662-672), so when the
+shards aren't present — the usual case for linting a conf checked into a
+repo — the pass degrades to an INFO note rather than a false ERROR.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..config.schema import ConfigError, ModelConfig
+from ..graph.builder import Net, active_phases, build_net
+from .core import Collector, ERROR, INFO, rule
+from .net_rules import SHD001
+
+SHP000 = rule(
+    "SHP000", INFO, "shape pass skipped: data source not found"
+)
+SHP001 = rule("SHP001", ERROR, "net construction / shape inference failed")
+SHP002 = rule(
+    "SHP002", ERROR, "abstract forward trace (jax.eval_shape) failed"
+)
+PRM001 = rule("PRM001", ERROR, "duplicate qualified param name")
+PRM002 = rule("PRM002", ERROR, "share_param references an unknown param")
+PRM003 = rule(
+    "PRM003", ERROR, "shared param's shape differs from its owner's"
+)
+SHD002 = rule(
+    "SHD002", ERROR, "param neuron/expert axis inconsistent with its shape"
+)
+
+
+def _collect_specs(net: Net, path: str, col: Collector) -> dict:
+    """All param specs with PRM001 dupes reported (Net.param_specs
+    fail-fasts on the first dupe; lint reports each)."""
+    specs: dict = {}
+    for layer in net.layers:
+        for name, spec in layer.param_specs().items():
+            if name in specs:
+                col.emit(
+                    PRM001,
+                    f"{path} (layer {layer.name!r})",
+                    f"param {name!r} already declared by another layer",
+                )
+            else:
+                specs[name] = spec
+    return specs
+
+
+def _share_rules(specs: dict, path: str, col: Collector) -> bool:
+    """PRM002/PRM003 over owner links; returns False when a link is so
+    broken the abstract trace would KeyError."""
+    ok = True
+    for name, spec in specs.items():
+        if spec.owner is None:
+            continue
+        owner = specs.get(spec.owner)
+        if owner is None:
+            col.emit(
+                PRM002,
+                f"{path} (param {name!r})",
+                f"share_param owner {spec.owner!r} is not a declared "
+                "param",
+                fix_hint="share_param entries name the owner as "
+                "'<layer>/<param>'",
+            )
+            ok = False
+        elif tuple(owner.shape) != tuple(spec.shape):
+            col.emit(
+                PRM003,
+                f"{path} (param {name!r})",
+                f"shape {tuple(spec.shape)} != owner {spec.owner!r} "
+                f"shape {tuple(owner.shape)}",
+            )
+    return ok
+
+
+def _sharding_rules_built(
+    net: Net,
+    widths: dict[str, int],
+    path: str,
+    col: Collector,
+    seen: set[str],
+) -> None:
+    """Precise SHD001/SHD002 from the inferred ParamSpecs — the same
+    divisibility condition parallel/shardings._param_layout applies when
+    it chooses pad-storage (model axis) or replicate (expert axis).
+    ``seen`` dedups params across phases: geometry is phase-independent,
+    but each phase can hold live layers every other phase excludes, so
+    the caller runs this on every built phase."""
+    nmodel = widths.get("model", 1)
+    nexpert = widths.get("expert", 1)
+    for layer in net.layers:
+        for name, spec in layer.param_specs().items():
+            if name in seen:
+                continue
+            seen.add(name)
+            ndim = len(spec.shape)
+            for label, axis, width, fallback in (
+                ("neuron_axis", spec.neuron_axis, nmodel, "pads storage"),
+                ("expert_axis", spec.expert_axis, nexpert, "replicates"),
+            ):
+                if axis is None:
+                    continue
+                if not 0 <= axis < ndim:
+                    col.emit(
+                        SHD002,
+                        f"{path} (param {name!r})",
+                        f"{label} {axis} out of range for shape "
+                        f"{tuple(spec.shape)}",
+                    )
+                    continue
+                if label == "neuron_axis" and layer.partition_dim != 1:
+                    continue  # not kLayerPartition: stays replicated
+                if width > 1 and spec.shape[axis] % width:
+                    col.emit(
+                        SHD001,
+                        f"{path} (param {name!r})",
+                        f"dim {axis} of shape {tuple(spec.shape)} not "
+                        f"divisible by {label.split('_')[0]} axis "
+                        f"{width}: {fallback} instead of sharding evenly",
+                        fix_hint=f"size the dim as a multiple of {width}",
+                    )
+
+
+def _abstract_forward(net: Net, specs: dict, phase: str) -> None:
+    """Trace Net.forward with ShapeDtypeStructs only (jax.eval_shape):
+    full shape/dtype propagation through every layer, zero compute."""
+    params = {
+        name: jax.ShapeDtypeStruct(tuple(spec.shape), jax.numpy.float32)
+        for name, spec in specs.items()
+        if spec.owner is None
+    }
+    batch = {}
+    for dl in net.datalayers:
+        batch[dl.name] = {
+            "image": jax.ShapeDtypeStruct(
+                (dl.batchsize, *dl.sample_shape), dl.images.dtype
+            ),
+            "label": jax.ShapeDtypeStruct(
+                (dl.batchsize,), dl.labels.dtype
+            ),
+        }
+    rng = jax.random.PRNGKey(0)
+
+    def fwd(p, b):
+        return net.forward(p, b, training=(phase == "kTrain"), rng=rng)
+
+    jax.eval_shape(fwd, params, batch)
+
+
+def shape_pass(
+    model_cfg: ModelConfig,
+    path: str,
+    col: Collector,
+    widths: dict[str, int] | None = None,
+) -> bool:
+    """Build + abstractly trace every active phase.
+
+    Returns True when at least one phase built (the caller then skips the
+    config-level sharding fallback — these checks ran on real specs).
+    """
+    built_any = False
+    shard_seen: set[str] = set()
+    for phase in active_phases(model_cfg):
+        try:
+            net = build_net(model_cfg, phase)
+        except OSError as e:
+            # data layers open their sources during setup; a conf in a
+            # repo usually points at shards that only exist on the
+            # training host. Not an error in the conf itself.
+            col.emit(
+                SHP000,
+                f"{path} (phase {phase})",
+                f"net not built, data source unavailable: {e}",
+            )
+            continue
+        except ConfigError as e:
+            col.emit(
+                SHP001, f"{path} (phase {phase})", str(e)
+            )
+            continue
+        except Exception as e:
+            # layer setup can raise arbitrary errors on degenerate
+            # configs (e.g. stride 0 -> ZeroDivisionError); one bad conf
+            # must not abort the diagnostics for every remaining file
+            msg = str(e).strip().split("\n")[0][:300]
+            col.emit(
+                SHP001,
+                f"{path} (phase {phase})",
+                f"{type(e).__name__}: {msg}",
+            )
+            continue
+        built_any = True
+        specs = _collect_specs(net, path, col)
+        links_ok = _share_rules(specs, path, col)
+        if widths:
+            _sharding_rules_built(net, widths, path, col, shard_seen)
+        if not links_ok:
+            continue
+        try:
+            _abstract_forward(net, specs, phase)
+        except Exception as e:  # eval_shape surfaces arbitrary layer errors
+            msg = str(e).strip().split("\n")[0][:300]
+            col.emit(
+                SHP002,
+                f"{path} (phase {phase})",
+                f"{type(e).__name__}: {msg}",
+            )
+    return built_any

@@ -17,11 +17,16 @@ are expressed as shardings on the jitted step's inputs:
                      hand ("the most complex scenario", neuralnet.cc:265-280).
 
 The reference gives the last partition any remainder (neuralnet.cc:160-162);
-XLA shards evenly, so an indivisible neuron dim falls back to replication
-for that param (documented divergence, SURVEY hard-part #3).
+XLA shards evenly, so an indivisible neuron dim pads its STORED array up to
+the next multiple (see _param_layout), and an indivisible expert count falls
+back to replication (documented divergence, SURVEY hard-part #3). Both
+fallbacks announce themselves via ``warnings.warn`` and are surfaced
+statically by netlint as SHD001 (``python -m singa_tpu.tools.lint``).
 """
 
 from __future__ import annotations
+
+import warnings
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -50,7 +55,7 @@ def batch_shardings(mesh: Mesh, net: Net) -> dict:
     return out
 
 
-def _param_layout(mesh: Mesh, net: Net):
+def _param_layout(mesh: Mesh, net: Net, *, warn: bool = False):
     """-> iterator of (name, spec, sharded_axis | None, pad).
 
     ``sharded_axis`` is the param dim sharded over a mesh axis (with the
@@ -74,16 +79,33 @@ def _param_layout(mesh: Mesh, net: Net):
             ):
                 d = spec.shape[spec.neuron_axis]
                 pad = -d % nmodel
+                if pad and warn:
+                    # lint surfaces the same condition statically (SHD001)
+                    warnings.warn(
+                        f"layer {layer.name!r}: kLayerPartition dim "
+                        f"{spec.neuron_axis} of param {name!r} (size {d}) "
+                        f"is not divisible by the model axis ({nmodel}); "
+                        f"storage pads to {d + pad}",
+                        stacklevel=3,
+                    )
                 yield name, spec, (spec.neuron_axis, MODEL_AXIS), pad
-            elif (
-                spec.expert_axis is not None
-                and nexpert > 1
-                and spec.shape[spec.expert_axis] % nexpert == 0
-            ):
-                # kMoE expert weights split over the expert axis
-                # regardless of partition_type — expert parallelism is
-                # the layer's intrinsic layout, not a net-wide choice
-                yield name, spec, (spec.expert_axis, "expert"), 0
+            elif spec.expert_axis is not None and nexpert > 1:
+                if spec.shape[spec.expert_axis] % nexpert:
+                    if warn:
+                        warnings.warn(
+                            f"layer {layer.name!r}: expert dim "
+                            f"{spec.expert_axis} of param {name!r} (size "
+                            f"{spec.shape[spec.expert_axis]}) is not "
+                            f"divisible by the expert axis ({nexpert}); "
+                            "falling back to replication",
+                            stacklevel=3,
+                        )
+                    yield name, spec, None, 0
+                else:
+                    # kMoE expert weights split over the expert axis
+                    # regardless of partition_type — expert parallelism is
+                    # the layer's intrinsic layout, not a net-wide choice
+                    yield name, spec, (spec.expert_axis, "expert"), 0
             else:
                 yield name, spec, None, 0
 
@@ -99,7 +121,7 @@ def param_shardings(mesh: Mesh, net: Net) -> dict[str, NamedSharding]:
     param_paddings / _param_layout).
     """
     out: dict[str, NamedSharding] = {}
-    for name, spec, sharded, _pad in _param_layout(mesh, net):
+    for name, spec, sharded, _pad in _param_layout(mesh, net, warn=True):
         if sharded is None:
             out[name] = replicated(mesh)
         else:

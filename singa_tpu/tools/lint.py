@@ -1,0 +1,214 @@
+"""netlint CLI: static validation for job configs and JAX-hazard source lint.
+
+Usage:
+  python -m singa_tpu.tools.lint examples/                 # every conf
+  python -m singa_tpu.tools.lint job.conf --cluster c.conf # + sharding
+  python -m singa_tpu.tools.lint --self                    # AST pass over
+                                                           # singa_tpu/
+  python -m singa_tpu.tools.lint --list-rules              # rule catalogue
+
+Paths may be .conf files, .py files, or directories (recursively linting
+both kinds). Model vs cluster confs are told apart by their fields
+(``nworkers``/``workspace`` mark a cluster conf). Sharding divisibility
+rules (SHD*) need mesh axis widths, so they run only when ``--cluster``
+supplies a cluster conf.
+
+Exit status: 0 = no ERROR diagnostics (WARNING/INFO allowed), 1 = at
+least one ERROR (or any WARNING under ``--strict``), 2 = usage error.
+Suppress codes globally with ``--ignore CODE[,CODE]``; suppress AST
+findings per line with ``# netlint: disable=CODE``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..config import textproto
+from ..lint import (
+    Collector,
+    lint_cluster_text,
+    lint_model_text,
+    lint_python_file,
+    render_json,
+    render_rule_table,
+    render_text,
+    sharding_rules_static,
+)
+from ..lint.ast_rules import PRUNE_DIRS
+from ..lint.net_rules import CFG000
+from ..lint.shape_rules import shape_pass
+
+
+def _is_cluster_raw(raw: dict) -> bool:
+    return "nworkers" in raw or "workspace" in raw
+
+
+def _lint_conf(
+    path: str, col: Collector, widths: dict[str, int] | None
+) -> None:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        col.emit(CFG000, path, f"cannot read: {e}")
+        return
+    try:
+        raw = textproto.parse(text)
+    except textproto.TextProtoError as e:
+        col.emit(CFG000, path, str(e))
+        return
+    if _is_cluster_raw(raw):
+        lint_cluster_text(text, path, col, raw=raw)
+        return
+    errors_before = col.count("ERROR")
+    model_cfg = lint_model_text(text, path, col, raw=raw)
+    if model_cfg is None:
+        return
+    if col.count("ERROR") > errors_before:
+        # the graph is already known-broken; building it would only
+        # re-report the same breakage through SHP001. The config-level
+        # sharding checks are independent of graph validity, though —
+        # report everything in one run
+        if widths:
+            sharding_rules_static(model_cfg, widths, path, col)
+        return
+    built = shape_pass(model_cfg, path, col, widths)
+    if widths:
+        # batch divisibility (SHD003) is config-level and always applies;
+        # the SHD001 neuron-dim heuristic is only the fallback for nets
+        # that could not build (data sources absent) — built nets got the
+        # precise per-param check in shape_pass
+        sharding_rules_static(
+            model_cfg, widths, path, col, neuron_dims=not built
+        )
+
+
+def _collect(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
+    """-> (conf files, python files, missing)."""
+    confs: list[str] = []
+    pys: list[str] = []
+    missing: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
+                for f in sorted(filenames):
+                    full = os.path.join(dirpath, f)
+                    if f.endswith(".conf"):
+                        confs.append(full)
+                    elif f.endswith(".py"):
+                        pys.append(full)
+        elif os.path.isfile(p):
+            (confs if not p.endswith(".py") else pys).append(p)
+        else:
+            missing.append(p)
+    return confs, pys, missing
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="singa_tpu.tools.lint",
+        description="static config/graph/sharding validator + JAX lint",
+    )
+    ap.add_argument("paths", nargs="*", help=".conf/.py files or dirs")
+    ap.add_argument(
+        "--cluster",
+        default=None,
+        help="cluster conf supplying mesh axis widths for SHD* rules",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--self",
+        action="store_true",
+        dest="self_lint",
+        help="AST-lint the installed singa_tpu package source",
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="treat WARNING diagnostics as failures",
+    )
+    ap.add_argument(
+        "--ignore",
+        default="",
+        help="comma-separated diagnostic codes to drop",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rule_table())
+        return 0
+    if not args.paths and not args.self_lint:
+        ap.print_usage(sys.stderr)
+        print(
+            "error: give at least one path, or --self / --list-rules",
+            file=sys.stderr,
+        )
+        return 2
+
+    col = Collector(
+        ignore={c.strip() for c in args.ignore.split(",") if c.strip()}
+    )
+
+    widths = None
+    if args.cluster:
+        try:
+            with open(args.cluster, "r", encoding="utf-8") as f:
+                ctext = f.read()
+        except OSError as e:
+            print(f"error: --cluster {args.cluster}: {e}", file=sys.stderr)
+            return 2
+        _, widths = lint_cluster_text(ctext, args.cluster, col)
+
+    confs, pys, bad = _collect(args.paths)
+    if bad:
+        for p in bad:
+            print(f"error: no such path {p!r}", file=sys.stderr)
+        return 2
+    # --cluster already linted its file; don't report it twice when the
+    # same conf also arrives via the positional paths
+    cluster_real = (
+        os.path.realpath(args.cluster) if args.cluster else None
+    )
+    for path in confs:
+        if cluster_real and os.path.realpath(path) == cluster_real:
+            continue
+        _lint_conf(path, col, widths)
+    if args.self_lint:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for dirpath, dirnames, filenames in os.walk(pkg_root):
+            dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    pys.append(os.path.join(dirpath, f))
+    # `lint singa_tpu/ --self` must not report every finding twice
+    seen_py: set[str] = set()
+    for path in pys:
+        real = os.path.realpath(path)
+        if real not in seen_py:
+            seen_py.add(real)
+            lint_python_file(path, col)
+
+    diags = col.sorted()
+    if args.format == "json":
+        print(render_json(diags))
+    elif diags:
+        print(render_text(diags))
+    nerr = col.count("ERROR")
+    nwarn = col.count("WARNING")
+    if args.format == "text":
+        scanned = len(confs) + len(seen_py)
+        print(
+            f"netlint: {scanned} target(s), {nerr} error(s), "
+            f"{nwarn} warning(s)"
+        )
+    return 1 if col.has_errors(strict=args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -218,7 +218,9 @@ class ReplicaTrainer(Trainer):
             def fn(replicas, center):
                 return elastic_sync(replicas, center, alpha)
 
-            return jax.jit(fn)
+            # sync runs once per window, not per step; donation's saving
+            # is negligible and CPU test runs warn on unused donations
+            return jax.jit(fn)  # netlint: disable=JAX003
 
         # ratio is fixed once bootstrap ran (_build_sync is lazy), so
         # full coverage is a static property of the compiled sync
@@ -229,7 +231,8 @@ class ReplicaTrainer(Trainer):
                 replicas, snapshots, center, indices, full_coverage=full
             )
 
-        return jax.jit(fn)
+        # once-per-window protocol round, same tradeoff as elastic_sync
+        return jax.jit(fn)  # netlint: disable=JAX003
 
     # ------------------------------------------------------------------
     # host-side loop hooks

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..config.schema import ClusterConfig, ConfigError, ModelConfig
 from ..data.pipeline import BatchPipeline
-from ..graph.builder import Net, build_net
+from ..graph.builder import Net, active_phases, build_net
 from ..optim import make_updater
 from ..parallel import (
     batch_shardings,
@@ -82,15 +82,18 @@ class Trainer:
         self.timers = Timers()
 
         # --- nets (SetupNeuralNet x3, phase-filtered; worker.cc:16-27) ---
+        # active_phases is the single source of truth for which nets a job
+        # builds — netlint validates exactly the same set
+        phases = active_phases(model_cfg)
         self.train_net = build_net(model_cfg, "kTrain")
-        self.test_net: Net | None = None
-        self.val_net: Net | None = None
-        # built whenever steps are configured (like worker.cc:16-27 — the
-        # cadence only gates *running* them)
-        if model_cfg.test_steps:
-            self.test_net = build_net(model_cfg, "kTest")
-        if model_cfg.validation_steps:
-            self.val_net = build_net(model_cfg, "kValidation")
+        self.test_net: Net | None = (
+            build_net(model_cfg, "kTest") if "kTest" in phases else None
+        )
+        self.val_net: Net | None = (
+            build_net(model_cfg, "kValidation")
+            if "kValidation" in phases
+            else None
+        )
 
         # --- params + updater (ParamManager ctor + InitParams) ---
         self.specs = self.train_net.param_specs()
@@ -548,7 +551,9 @@ class Trainer:
             def eval_fn(params, buffers, batch):
                 return self._eval_batch_metrics(net, params, buffers, batch)
 
-            self._eval_steps[id(net)] = jax.jit(eval_fn)
+            # eval traces the LIVE training params/buffers; donating them
+            # would invalidate the arrays the next train step needs
+            self._eval_steps[id(net)] = jax.jit(eval_fn)  # netlint: disable=JAX003
         return self._eval_steps[id(net)]
 
     # ------------------------------------------------------------------
@@ -780,7 +785,8 @@ class Trainer:
             _, metrics = jax.lax.scan(body, 0, jnp.arange(nsteps))
             return jax.tree.map(lambda a: a.sum(axis=0), metrics)
 
-        return jax.jit(chunk_fn)
+        # like _eval_step_for: params stay live across the eval chunk
+        return jax.jit(chunk_fn)  # netlint: disable=JAX003
 
     def evaluate(self, net: Net, nsteps: int, phase: str, step: int) -> dict:
         """Test/Validate (worker.cc:318-348): nsteps batches, averaged."""

@@ -1,0 +1,875 @@
+"""netlint tests: golden bad-config fixtures assert exact diagnostic
+codes, the shipped examples lint clean, the AST pass self-lints the
+package with zero ERRORs, and the build-based shape/sharding passes run
+against real generated shards."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from singa_tpu.config.schema import ModelConfig, parse_model_config
+from singa_tpu.data.loader import synthetic_arrays, write_records
+from singa_tpu.lint import Collector, lint_model_text, lint_python_file
+from singa_tpu.lint.ast_rules import lint_python_tree
+from singa_tpu.lint.shape_rules import shape_pass
+from singa_tpu.tools import lint as lint_cli
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "singa_tpu"
+
+
+def run_cli(capsys, *argv):
+    rc = lint_cli.main(["--format", "json", *argv])
+    doc = json.loads(capsys.readouterr().out)
+    codes = {d["code"] for d in doc["diagnostics"]}
+    return rc, codes, doc
+
+
+# ---------------------------------------------------------------------------
+# golden bad-config fixtures -> exact codes + non-zero exit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "fixture, code",
+    [
+        ("bad_dangling.conf", "NET001"),
+        ("bad_cycle.conf", "NET002"),
+        ("bad_phase.conf", "NET003"),
+        ("bad_enum.conf", "CFG002"),
+    ],
+)
+def test_golden_fixture_fails_with_code(capsys, fixture, code):
+    rc, codes, _ = run_cli(capsys, str(FIXTURES / fixture))
+    assert rc == 1
+    assert code in codes
+
+
+def test_graph_error_does_not_suppress_sharding_checks(capsys, tmp_path):
+    # one run reports every problem: a dangling srclayer (graph ERROR)
+    # must not hide the independent SHD003 batch-divisibility warning
+    job = tmp_path / "job.conf"
+    job.write_text(
+        """
+        train_steps: 2
+        neuralnet {
+          layer { name: "data" type: "kShardData"
+                  data_param { path: "nope" batchsize: 7 } }
+          layer { name: "mnist" type: "kMnistImage" srclayers: "dataa" }
+        }
+        """
+    )
+    cluster = tmp_path / "cluster.conf"
+    cluster.write_text(
+        'nworkers: 2\nnprocs_per_group: 1\nworkspace: "ws"\n'
+    )
+    rc, codes, _ = run_cli(capsys, str(job), "--cluster", str(cluster))
+    assert rc == 1 and "NET001" in codes and "SHD003" in codes
+
+
+def test_golden_indivisible_partition(capsys):
+    # SHD001 is a WARNING (the runtime pads and proceeds): clean exit by
+    # default, non-zero under --strict — the CI examples gate uses strict
+    path = str(FIXTURES / "bad_partition.conf")
+    cluster = str(FIXTURES / "cluster_model2.conf")
+    rc, codes, _ = run_cli(capsys, path, "--cluster", cluster)
+    assert rc == 0 and "SHD001" in codes
+    rc, codes, _ = run_cli(capsys, path, "--cluster", cluster, "--strict")
+    assert rc == 1 and "SHD001" in codes
+    # without the cluster conf there is no model axis: no SHD001
+    rc, codes, _ = run_cli(capsys, path)
+    assert rc == 0 and "SHD001" not in codes
+
+
+def test_dangling_fix_hint_has_did_you_mean(capsys):
+    _, _, doc = run_cli(capsys, str(FIXTURES / "bad_dangling.conf"))
+    net001 = [d for d in doc["diagnostics"] if d["code"] == "NET001"]
+    assert net001 and "mnist" in net001[0]["fix_hint"]
+
+
+def test_enum_fix_hint_has_did_you_mean(capsys):
+    _, _, doc = run_cli(capsys, str(FIXTURES / "bad_enum.conf"))
+    by_code = {}
+    for d in doc["diagnostics"]:
+        by_code.setdefault(d["code"], []).append(d)
+    hints = " ".join(d["fix_hint"] for d in by_code["CFG002"])
+    assert "kSGD" in hints
+    # kGausian should suggest a Gaussian spelling (alias or reference)
+    assert "kGauss" in hints or "kGaussain" in hints
+
+
+# ---------------------------------------------------------------------------
+# shipped configs + self-lint stay clean (the CI gate)
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_examples_lint_clean(capsys):
+    rc, codes, doc = run_cli(capsys, str(REPO / "examples"))
+    assert rc == 0, doc
+    assert doc["counts"]["ERROR"] == 0
+
+
+def test_self_lint_zero_errors():
+    # meta-test: the AST JAX-hazard pass over singa_tpu/ itself
+    col = Collector()
+    nfiles = lint_python_tree(str(PKG), col)
+    assert nfiles > 40  # sanity: actually walked the package
+    errors = [d for d in col.diagnostics if d.severity == "ERROR"]
+    assert not errors, "\n".join(str(d) for d in errors)
+
+
+# ---------------------------------------------------------------------------
+# config walk details
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_field_did_you_mean():
+    col = Collector()
+    lint_model_text("train_stepz: 5\n", "x.conf", col)
+    d = [d for d in col.diagnostics if d.code == "CFG001"]
+    assert d and "train_steps" in d[0].fix_hint
+
+
+def test_scalar_type_error_not_masked_by_walk_errors():
+    # regression: the strict-parse ConfigError used to be swallowed
+    # whenever the walk reported ANY error — a conf with an unknown field
+    # AND a bad scalar reported only the field, hiding the type error
+    col = Collector()
+    lint_model_text(
+        'bogus_field: 1\n'
+        'neuralnet { layer { name: "d" type: "kShardData"\n'
+        '  data_param { path: "x" batchsize: "notanint" } } }\n',
+        "x.conf",
+        col,
+    )
+    codes = {d.code for d in col.diagnostics}
+    assert "CFG001" in codes
+    type_errors = [
+        d for d in col.diagnostics
+        if d.code == "CFG000" and "notanint" in d.msg
+    ]
+    assert len(type_errors) == 1, col.diagnostics
+
+
+def test_missing_required_field_reported_alongside_walk_errors():
+    col = Collector()
+    lint_model_text(
+        'bogus_field: 1\n'
+        'neuralnet { layer { name: "fc" type: "kDense"\n'
+        '  dense_param { } } }\n',
+        "x.conf",
+        col,
+    )
+    required = [
+        d for d in col.diagnostics
+        if d.code == "CFG000" and "num_output" in d.msg
+    ]
+    assert len(required) == 1, col.diagnostics
+
+
+def test_exact_enum_member_beats_alias_rewrite():
+    # a vocabulary that legitimately contains the corrected spelling must
+    # accept it verbatim — aliasing only rescues absent spellings
+    from singa_tpu.config.schema import Field
+
+    f = Field("enum", enum=("kGaussian", "kUniform"))
+    assert f.convert("kGaussian", "m") == "kGaussian"
+
+
+def test_kgaussian_alias_parses_and_normalizes():
+    cfg = parse_model_config(
+        """
+        neuralnet {
+          layer {
+            name: "fc" type: "kInnerProduct"
+            inner_product_param { num_output: 4 }
+            param { name: "w" init_method: kGaussian }
+          }
+        }
+        """
+    )
+    assert cfg.neuralnet.layer[0].param[0].init_method == "kGaussain"
+
+
+def test_sic_spelling_in_wrong_field_is_cfg002_not_cfg003():
+    # kGaussain is only valid where the enum actually contains it; used
+    # in another enum field it must be a membership error, not an
+    # "accepted as an alias" note
+    col = Collector()
+    lint_model_text(
+        "updater { type: kGaussain }\n", "x.conf", col
+    )
+    codes = {d.code for d in col.diagnostics}
+    assert "CFG002" in codes and "CFG003" not in codes
+
+
+def test_kgaussain_sic_spelling_gets_info_note():
+    col = Collector()
+    lint_model_text(
+        """
+        neuralnet {
+          layer {
+            name: "fc" type: "kInnerProduct"
+            inner_product_param { num_output: 4 }
+            param { name: "w" init_method: kGaussain }
+          }
+        }
+        """,
+        "x.conf",
+        col,
+    )
+    notes = [d for d in col.diagnostics if d.code == "CFG003"]
+    assert len(notes) == 1 and notes[0].severity == "INFO"
+
+
+def test_duplicate_srclayers_edge_is_not_a_cycle():
+    # a layer may list the same src twice (concat with itself); Kahn's
+    # residue must not misreport the duplicate edge as a cycle
+    col = Collector()
+    lint_model_text(
+        """
+        train_steps: 2
+        neuralnet {
+          layer { name: "data" type: "kShardData"
+                  data_param { path: "x" batchsize: 4 } }
+          layer { name: "cat" type: "kAdd"
+                  srclayers: "data" srclayers: "data" }
+        }
+        """,
+        "x.conf",
+        col,
+    )
+    assert not [d for d in col.diagnostics if d.code == "NET002"]
+
+
+def test_alias_in_wrong_field_error_names_user_spelling():
+    # the strict parse must report the token the user wrote, not the
+    # alias-normalized one (kGaussian -> kGaussain)
+    with pytest.raises(Exception, match="kGaussian"):
+        parse_model_config("updater { type: kGaussian }\n")
+
+
+def test_line_locator_prefers_whole_token():
+    # resnet50.conf-style: 'kGaussainSqrtFanIn' on an early line must not
+    # absorb the location of a later plain 'kGaussain'
+    text = (
+        "neuralnet {\n"
+        '  layer { name: "a" type: "kInnerProduct"\n'
+        "    inner_product_param { num_output: 4 }\n"
+        '    param { name: "w" init_method: kGaussainSqrtFanIn } }\n'
+        '  layer { name: "b" type: "kInnerProduct" srclayers: "a"\n'
+        "    inner_product_param { num_output: 4 }\n"
+        '    param { name: "w2" init_method: kGaussain } }\n'
+        "}\n"
+    )
+    col = Collector()
+    lint_model_text(text, "x.conf", col)
+    locs = {
+        d.loc for d in col.diagnostics if "'kGaussain'" in d.msg
+    }
+    assert "x.conf:7" in locs, col.diagnostics
+
+
+def test_duplicate_layers_only_flagged_in_active_phases():
+    # the shipped two-data-layer idiom: both live in kValidation, but
+    # kValidation is inactive (no validation_steps) -> clean
+    conf = """
+    train_steps: 5
+    neuralnet {{
+      layer {{ name: "data" type: "kShardData"
+              data_param {{ path: "x" batchsize: 4 }} exclude: kTest }}
+      layer {{ name: "data" type: "kShardData"
+              data_param {{ path: "y" batchsize: 4 }} exclude: kTrain }}
+    }}
+    {extra}
+    """
+    col = Collector()
+    lint_model_text(conf.format(extra=""), "x.conf", col)
+    assert not [d for d in col.diagnostics if d.code == "NET004"]
+    col = Collector()
+    lint_model_text(
+        conf.format(extra="validation_steps: 2"), "x.conf", col
+    )
+    assert [d for d in col.diagnostics if d.code == "NET004"]
+
+
+# ---------------------------------------------------------------------------
+# build-based passes over real shards
+# ---------------------------------------------------------------------------
+
+SHARDED_CONF = """
+name: "lint-built"
+train_steps: 4
+neuralnet {{
+  layer {{
+    name: "data" type: "kShardData"
+    data_param {{ path: "{shard}" batchsize: 8 }}
+  }}
+  layer {{ name: "mnist" type: "kMnistImage" srclayers: "data" }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{
+    name: "fc1" type: "kInnerProduct" srclayers: "mnist"
+    inner_product_param {{ num_output: {nout} }} {extra_fc1}
+  }}
+  layer {{
+    name: "loss" type: "kSoftmaxLoss"
+    srclayers: "fc1" srclayers: "label"
+  }}
+}}
+"""
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("lintshard") / "train")
+    write_records(d, *synthetic_arrays(32, classes=4, size=8))
+    return d
+
+
+def _lint_built(shard, nout=4, extra_fc1="", widths=None):
+    cfg = parse_model_config(
+        SHARDED_CONF.format(shard=shard, nout=nout, extra_fc1=extra_fc1)
+    )
+    col = Collector()
+    built = shape_pass(cfg, "x.conf", col, widths)
+    return built, col
+
+
+def test_shape_pass_builds_and_traces_clean(shard_dir):
+    built, col = _lint_built(shard_dir)
+    assert built
+    assert not [d for d in col.diagnostics if d.severity == "ERROR"]
+
+
+def test_shape_pass_reports_layer_contract_break(shard_dir):
+    # kSoftmaxLoss with a single srclayer violates its (pred, label)
+    # contract — surfaces via the build as SHP001 (setup raises)
+    cfg = parse_model_config(
+        SHARDED_CONF.format(
+            shard=shard_dir, nout=4, extra_fc1=""
+        ).replace('srclayers: "fc1" srclayers: "label"', 'srclayers: "fc1"')
+    )
+    col = Collector()
+    shape_pass(cfg, "x.conf", col)
+    assert [d for d in col.diagnostics if d.code in ("SHP001", "SHP002")]
+
+
+def test_built_sharding_divisibility(shard_dir):
+    widths = {"data": 1, "model": 2, "expert": 1, "seq": 1, "pipe": 1}
+    _, col = _lint_built(
+        shard_dir,
+        nout=7,
+        extra_fc1="partition_type: kLayerPartition",
+        widths=widths,
+    )
+    hits = [d for d in col.diagnostics if d.code == "SHD001"]
+    assert hits and "7" in hits[0].msg and hits[0].severity == "WARNING"
+    # divisible dim -> silent
+    _, col = _lint_built(
+        shard_dir,
+        nout=8,
+        extra_fc1="partition_type: kLayerPartition",
+        widths=widths,
+    )
+    assert not [d for d in col.diagnostics if d.code == "SHD001"]
+
+
+def test_built_sharding_covers_phase_excluded_layers(shard_dir):
+    # regression: SHD001/SHD002 used to run only on the first built
+    # phase's net, so a kTest-only layer (exclude: kTrain) with an
+    # indivisible dim was never checked when the data WAS present
+    conf = f"""
+    train_steps: 4
+    test_steps: 2
+    test_frequency: 2
+    neuralnet {{
+      layer {{ name: "data" type: "kShardData"
+              data_param {{ path: "{shard_dir}" batchsize: 8 }} }}
+      layer {{ name: "mnist" type: "kMnistImage" srclayers: "data" }}
+      layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+      layer {{ name: "fc1" type: "kInnerProduct" srclayers: "mnist"
+              inner_product_param {{ num_output: 8 }}
+              partition_type: kLayerPartition }}
+      layer {{ name: "fc_test" type: "kInnerProduct" srclayers: "mnist"
+              inner_product_param {{ num_output: 7 }}
+              partition_type: kLayerPartition exclude: kTrain }}
+      layer {{ name: "loss" type: "kSoftmaxLoss"
+              srclayers: "fc1" srclayers: "label" exclude: kTest }}
+      layer {{ name: "loss_t" type: "kSoftmaxLoss"
+              srclayers: "fc_test" srclayers: "label" exclude: kTrain }}
+    }}
+    """
+    widths = {"data": 1, "model": 2, "expert": 1, "seq": 1, "pipe": 1}
+    col = Collector()
+    built = shape_pass(parse_model_config(conf), "x.conf", col, widths)
+    assert built
+    hits = [d for d in col.diagnostics if d.code == "SHD001"]
+    assert any("fc_test" in d.loc for d in hits), col.diagnostics
+    # params live in several phases are still reported once
+    locs = [d.loc for d in hits]
+    assert len(locs) == len(set(locs)), locs
+
+
+def test_degenerate_layer_setup_is_shp001_not_crash(shard_dir):
+    # stride 0 raises ZeroDivisionError inside layer setup; lint must
+    # turn that into a diagnostic, not abort the whole run
+    conf = f"""
+    train_steps: 2
+    neuralnet {{
+      layer {{
+        name: "data" type: "kShardData"
+        data_param {{ path: "{shard_dir}" batchsize: 8 }}
+      }}
+      layer {{ name: "mnist" type: "kMnistImage" srclayers: "data" }}
+      layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+      layer {{
+        name: "conv" type: "kConvolution" srclayers: "mnist"
+        convolution_param {{ num_filters: 4 kernel: 3 stride: 0 }}
+      }}
+      layer {{
+        name: "loss" type: "kSoftmaxLoss"
+        srclayers: "conv" srclayers: "label"
+      }}
+    }}
+    """
+    col = Collector()
+    shape_pass(parse_model_config(conf), "x.conf", col)
+    assert [d for d in col.diagnostics if d.code == "SHP001"]
+
+
+def test_batch_divisibility_checked_even_when_net_builds(
+    capsys, shard_dir, tmp_path
+):
+    # regression: SHD003 used to run only on the unbuildable-net fallback
+    # path, so a conf whose shards WERE present skipped the batch check
+    job = tmp_path / "job.conf"
+    job.write_text(
+        SHARDED_CONF.format(shard=shard_dir, nout=4, extra_fc1="").replace(
+            "batchsize: 8", "batchsize: 7"
+        )
+    )
+    cluster = tmp_path / "cluster.conf"
+    cluster.write_text(
+        'nworkers: 2\nnprocs_per_group: 1\nworkspace: "ws"\n'
+    )
+    rc, codes, doc = run_cli(capsys, str(job), "--cluster", str(cluster))
+    assert rc == 0 and "SHD003" in codes
+    # the precise built-net pass owns SHD001; the config-level heuristic
+    # must not double-report on top of it
+    assert "SHD001" not in codes
+
+
+def test_share_param_shape_mismatch(shard_dir):
+    conf = f"""
+    name: "lint-share"
+    train_steps: 2
+    neuralnet {{
+      layer {{
+        name: "data" type: "kShardData"
+        data_param {{ path: "{shard_dir}" batchsize: 8 }}
+      }}
+      layer {{ name: "mnist" type: "kMnistImage" srclayers: "data" }}
+      layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+      layer {{
+        name: "fc1" type: "kInnerProduct" srclayers: "mnist"
+        inner_product_param {{ num_output: 4 }}
+      }}
+      layer {{
+        name: "fc2" type: "kInnerProduct" srclayers: "fc1"
+        inner_product_param {{ num_output: 4 }}
+        share_param: "fc1/weight"
+      }}
+      layer {{
+        name: "loss" type: "kSoftmaxLoss"
+        srclayers: "fc2" srclayers: "label"
+      }}
+    }}
+    """
+    col = Collector()
+    shape_pass(parse_model_config(conf), "x.conf", col)
+    # fc1/weight is (64, 4); fc2's weight is (4, 4) -> shape mismatch
+    assert [d for d in col.diagnostics if d.code == "PRM003"]
+
+
+def test_share_param_unknown_owner(shard_dir):
+    conf = SHARDED_CONF.format(
+        shard=shard_dir, nout=4, extra_fc1='share_param: "nope/weight"'
+    )
+    col = Collector()
+    shape_pass(parse_model_config(conf), "x.conf", col)
+    assert [d for d in col.diagnostics if d.code == "PRM002"]
+
+
+# ---------------------------------------------------------------------------
+# AST pass unit tests
+# ---------------------------------------------------------------------------
+
+
+def _lint_py(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    col = Collector()
+    lint_python_file(str(p), col)
+    return col
+
+
+def test_ast_host_sync_in_jitted_fn(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return float(jnp.mean(x))
+        """,
+    )
+    assert [d for d in col.diagnostics if d.code == "JAX001"]
+
+
+def test_ast_item_in_fn_passed_to_jit(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+
+        def step(x):
+            return x.sum().item()
+
+        fast = jax.jit(step)
+        """,
+    )
+    hits = [d for d in col.diagnostics if d.code == "JAX001"]
+    assert hits and hits[0].severity == "ERROR"
+
+
+def test_ast_same_name_host_helper_in_sibling_scope_not_flagged(tmp_path):
+    # lexical scoping: the host-side fn in method B must not be scanned
+    # because method A jits ITS OWN closure also named fn
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+
+        class T:
+            def a(self):
+                def fn(x):
+                    return x + 1
+                return jax.jit(fn)
+
+            def b(self, v):
+                def fn(v):
+                    return v.item()
+                return fn(v)
+        """,
+    )
+    assert not [d for d in col.diagnostics if d.code == "JAX001"]
+
+
+def test_ast_jitted_closure_in_same_scope_still_flagged(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+
+        class T:
+            def a(self):
+                def fn(x):
+                    return x.sum().item()
+                return jax.jit(fn)
+        """,
+    )
+    assert [d for d in col.diagnostics if d.code == "JAX001"]
+
+
+def test_ast_host_sync_outside_jit_not_flagged(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def log_metrics(x):
+            return float(jnp.mean(x))
+        """,
+    )
+    assert not col.diagnostics
+
+
+def test_ast_disable_inside_branch_body_does_not_suppress(tmp_path):
+    # the suppression must sit on the statement's header lines; a
+    # comment buried in the body cannot silence the enclosing finding
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if jnp.any(x > 0):
+                y = x * 2  # netlint: disable
+                return y
+            return -x
+        """,
+    )
+    assert [d for d in col.diagnostics if d.code == "JAX002"]
+
+
+def test_ast_branch_on_tracer(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+        """,
+    )
+    assert [d for d in col.diagnostics if d.code == "JAX002"]
+
+
+def test_ast_np_roundtrip_is_warning_jax005(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.asarray(x).sum()
+        """,
+    )
+    hits = [d for d in col.diagnostics if d.code == "JAX005"]
+    assert hits and hits[0].severity == "WARNING"
+    assert not [d for d in col.diagnostics if d.code == "JAX001"]
+
+
+def test_ast_syntax_error_is_jax000(tmp_path):
+    col = _lint_py(tmp_path, "def broken(:\n")
+    hits = [d for d in col.diagnostics if d.code == "JAX000"]
+    assert hits and hits[0].severity == "ERROR"
+
+
+def test_ast_unreadable_file_is_jax000_not_crash(tmp_path):
+    p = tmp_path / "binary.py"
+    p.write_bytes(b"\xff\xfe not utf8")
+    col = Collector()
+    lint_python_file(str(p), col)
+    assert [d for d in col.diagnostics if d.code == "JAX000"]
+
+
+def test_suppression_on_closing_line_of_multiline_call(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+
+        def step(p, b):
+            return p
+
+        compiled = jax.jit(
+            step,
+        )  # netlint: disable=JAX003
+        """,
+        name="trainer_multiline.py",
+    )
+    assert not [d for d in col.diagnostics if d.code == "JAX003"]
+
+
+def test_cli_cluster_conf_in_paths_not_double_reported(capsys, tmp_path):
+    p = tmp_path / "cluster.conf"
+    p.write_text(
+        'nworkers: 6\nnprocs_per_group: 6\nnseq_per_group: 4\n'
+        'workspace: "ws"\n'
+    )
+    rc, _, doc = run_cli(capsys, str(p), "--cluster", str(p))
+    assert rc == 1
+    assert doc["counts"]["ERROR"] == 1  # CLU001 once, not twice
+
+
+def test_suppression_survives_trailing_prose(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+
+        def step(p, b):
+            return p
+
+        compiled = jax.jit(step)  # netlint: disable=JAX003 TODO revisit
+        """,
+        name="trainer_prose.py",
+    )
+    assert not [d for d in col.diagnostics if d.code == "JAX003"]
+
+
+def test_ast_untyped_array_literal(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        SCALES = jnp.array([1.0, 2.0])
+        TYPED = jnp.array([1.0, 2.0], dtype=jnp.float32)
+        POSITIONAL = jnp.array([1, 2], jnp.int32)
+        """,
+    )
+    hits = [d for d in col.diagnostics if d.code == "JAX004"]
+    assert len(hits) == 1
+
+
+def test_ast_donate_rule_and_suppression(tmp_path):
+    source = """
+    import jax
+
+    def step(p, b):
+        return p
+
+    compiled = jax.jit(step){suffix}
+    """
+    col = _lint_py(
+        tmp_path, source.format(suffix=""), name="trainer_mod.py"
+    )
+    assert [d for d in col.diagnostics if d.code == "JAX003"]
+    col = _lint_py(
+        tmp_path,
+        source.format(suffix="  # netlint: disable=JAX003"),
+        name="trainer_mod2.py",
+    )
+    assert not [d for d in col.diagnostics if d.code == "JAX003"]
+    # non-trainer paths are exempt (donation only matters where step
+    # inputs die)
+    col = _lint_py(tmp_path, source.format(suffix=""), name="ops_mod.py")
+    assert not [d for d in col.diagnostics if d.code == "JAX003"]
+
+
+def test_ast_trainer_path_ignores_ancestor_dirs(tmp_path):
+    # a checkout under /home/trainer/... must not put every module on
+    # the JAX003 trainer path; only components at/under singa_tpu count
+    src = textwrap.dedent(
+        """
+        import jax
+
+        def step(p):
+            return p
+
+        compiled = jax.jit(step)
+        """
+    )
+    root = tmp_path / "trainer-ci" / "singa_tpu"
+    (root / "ops").mkdir(parents=True)
+    (root / "trainer").mkdir()
+    (root / "ops" / "mod.py").write_text(src)
+    (root / "trainer" / "mod.py").write_text(src)
+    col = Collector()
+    lint_python_file(str(root / "ops" / "mod.py"), col)
+    assert not [d for d in col.diagnostics if d.code == "JAX003"]
+    col = Collector()
+    lint_python_file(str(root / "trainer" / "mod.py"), col)
+    assert [d for d in col.diagnostics if d.code == "JAX003"]
+
+
+def test_ast_donate_rule_covers_decorator_forms(tmp_path):
+    col = _lint_py(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def step(p, b):
+            return p
+
+        @partial(jax.jit, static_argnums=0)
+        def step2(n, p):
+            return p
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step3(p, b):
+            return p
+        """,
+        name="trainer_dec.py",
+    )
+    hits = [d for d in col.diagnostics if d.code == "JAX003"]
+    assert len(hits) == 2, col.diagnostics
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_rules(capsys):
+    assert lint_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in ("NET001", "SHD001", "JAX001", "CFG003"):
+        assert code in out
+
+
+def test_cli_no_args_is_usage_error(capsys):
+    assert lint_cli.main([]) == 2
+
+
+def test_cli_missing_path(capsys):
+    assert lint_cli.main(["does/not/exist.conf"]) == 2
+
+
+def test_cli_self_plus_overlapping_path_lints_once(capsys):
+    # `lint singa_tpu/lint/ --self` covers the same files twice on the
+    # command line; each must be scanned (and counted) exactly once
+    rc, _, doc = run_cli(capsys, str(PKG / "lint"), "--self")
+    assert rc == 0
+    rc2, _, doc2 = run_cli(capsys, "--self")
+    assert rc2 == 0
+    assert doc["counts"] == doc2["counts"]
+
+
+def test_cli_ignore_drops_code(capsys):
+    # ignoring the graph rule lets the build-based pass rediscover the
+    # dangling edge as SHP001; ignore both for a clean exit
+    rc, codes, _ = run_cli(
+        capsys,
+        str(FIXTURES / "bad_dangling.conf"),
+        "--ignore",
+        "NET001,SHP001",
+    )
+    assert rc == 0 and "NET001" not in codes and "SHP001" not in codes
+
+
+def test_cli_bad_cluster_topology(capsys, tmp_path):
+    p = tmp_path / "cluster.conf"
+    p.write_text(
+        'nworkers: 6\nnprocs_per_group: 6\nnseq_per_group: 4\n'
+        'workspace: "ws"\n'
+    )
+    rc, codes, _ = run_cli(capsys, str(p))
+    assert rc == 1 and "CLU001" in codes
+
+
+def test_cli_doubly_broken_cluster_reports_both(capsys, tmp_path):
+    # nworkers < nprocs_per_group AND indivisible inner axes: one run
+    # must report both CLU002 and CLU001, not mask one behind the other
+    p = tmp_path / "cluster.conf"
+    p.write_text(
+        'nworkers: 2\nnprocs_per_group: 6\nnseq_per_group: 4\n'
+        'workspace: "ws"\n'
+    )
+    rc, codes, doc = run_cli(capsys, str(p))
+    assert rc == 1 and {"CLU001", "CLU002"} <= codes
+    assert doc["counts"]["ERROR"] == 2
+
+
+def test_cli_ngroups_only_error_is_clu002_once(capsys, tmp_path):
+    p = tmp_path / "cluster.conf"
+    p.write_text(
+        'nworkers: 2\nnprocs_per_group: 6\nworkspace: "ws"\n'
+    )
+    rc, codes, doc = run_cli(capsys, str(p))
+    assert rc == 1 and codes == {"CLU002"}
+    assert doc["counts"]["ERROR"] == 1

@@ -137,6 +137,10 @@ class TestLayerPartition:
             partition_type="kLayerPartition",
             steps=4,
         )
+        # the pad fallback is no longer silent: param_shardings warns
+        # with layer, dim, and axis size (and netlint flags it as SHD001)
+        with pytest.warns(UserWarning, match="not divisible by the model"):
+            param_shardings(t8.mesh, t8.train_net)
         pads = param_paddings(t8.mesh, t8.train_net)
         assert pads["fc2/weight"] == ((0, 0), (0, 6))
         assert pads["fc2/bias"] == ((0, 6),)
@@ -168,3 +172,54 @@ class TestLayerPartition:
             tmp_path / "g42", build_mesh(4, 2), partition_type="kLayerPartition"
         )
         _assert_same_params(t1, t42)
+
+
+class TestExpertSharding:
+    """The indivisible-expert fallback in _param_layout: replicate (no
+    phantom-expert padding is possible) and say so via warnings.warn —
+    the sibling of the neuron-pad warning pinned above."""
+
+    class _StubLayer:
+        partition_dim = 0
+
+        def __init__(self, name, specs):
+            self.name = name
+            self._specs = specs
+
+        def param_specs(self):
+            return self._specs
+
+    class _StubNet:
+        def __init__(self, layers):
+            self.layers = layers
+
+    def _moe_net(self, nexperts):
+        from singa_tpu.params import ParamSpec
+
+        spec = ParamSpec(
+            name="moe/w", shape=(nexperts, 4, 4), expert_axis=0
+        )
+        return self._StubNet([self._StubLayer("moe", {"moe/w": spec})])
+
+    def test_indivisible_expert_count_warns_and_replicates(self):
+        from singa_tpu.parallel.mesh import build_full_mesh
+
+        mesh = build_full_mesh({"expert": 2})
+        with pytest.warns(
+            UserWarning, match="divisible by the expert axis"
+        ):
+            sh = param_shardings(mesh, self._moe_net(3))
+        assert sh["moe/w"].spec == jax.sharding.PartitionSpec()
+
+    def test_divisible_expert_count_shards_silently(self):
+        import warnings as _warnings
+
+        from singa_tpu.parallel.mesh import build_full_mesh
+
+        mesh = build_full_mesh({"expert": 2})
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            sh = param_shardings(mesh, self._moe_net(4))
+        assert sh["moe/w"].spec == jax.sharding.PartitionSpec(
+            "expert", None, None
+        )
