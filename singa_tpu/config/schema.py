@@ -586,6 +586,55 @@ class UpdaterConfig(Message):
     }
 
 
+GUARD_POLICIES = ("kNone", "kSkip", "kRollback")
+
+
+class ResilienceConfig(Message):
+    """singa-tpu extension: fault-tolerance runtime knobs (resilience/).
+
+    Presence of this block opts the job into the supervised train loop:
+    ``resilience.supervisor.run`` catches crashes, restores the newest
+    complete checkpoint, and retries with bounded exponential backoff; a
+    crash-loop circuit breaker gives up loudly after ``max_restarts``
+    failures that each made less than ``restart_window_steps`` steps of
+    progress. SIGTERM/SIGINT drain the current step, write a final
+    checkpoint, and exit resumable (TPU maintenance-event discipline).
+    The reference's availability story was the parameter-server tier a
+    restarted worker group rejoined (src/main.cc:49-55) plus the
+    never-implemented Worker::Resume (src/worker/worker.cc:65-67); with
+    no server tier, this block is the trainer-side replacement.
+    """
+
+    FIELDS = {
+        # --- supervisor: crash-loop circuit breaker + backoff ---
+        # give up after this many restarts that each progressed fewer
+        # than restart_window_steps steps (a restart that gets past the
+        # window resets the breaker); 0 = never restart
+        "max_restarts": Field("int", 3),
+        "restart_window_steps": Field("int", 1),
+        # exponential backoff between restarts: base * 2^k seconds,
+        # capped at backoff_max (tests set base 0 for instant retries)
+        "backoff_base": Field("float", 1.0),
+        "backoff_max": Field("float", 60.0),
+        # --- retention: keep-last-N complete checkpoints + LATEST ---
+        "keep_last": Field("int", 3),
+        # --- divergence guard (on-device; no per-step host sync) ---
+        # kSkip: drop a non-finite step's update and count it;
+        # kRollback: additionally restore the last checkpoint with an LR
+        # backoff after guard_rollback_after consecutive bad steps
+        "guard_policy": Field("enum", "kNone", enum=GUARD_POLICIES),
+        "guard_rollback_after": Field("int", 3),
+        # effective-LR multiplier applied at each rollback (grads are
+        # scaled by the accumulated factor inside the jitted step)
+        "guard_lr_backoff": Field("float", 0.5),
+        # --- hung-step watchdog: dump diagnostics when a step exceeds
+        # this many seconds without reaching a boundary; 0 = disabled ---
+        "watchdog_timeout": Field("float", 0.0),
+        # write a final checkpoint when draining on SIGTERM/SIGINT
+        "preemption_checkpoint": Field("bool", True),
+    }
+
+
 class ModelConfig(Message):
     FIELDS = {
         "name": Field("string"),
@@ -626,6 +675,9 @@ class ModelConfig(Message):
         # pipe axis). 0 = the pipe width (the GPipe minimum); more
         # microbatches shrink the fill/drain bubble. ---
         "pipeline_microbatches": Field("int", 0),
+        # --- singa-tpu extension: fault-tolerance runtime (supervised
+        # auto-resume, preemption drain, divergence guard, watchdog) ---
+        "resilience": Field("message", message=ResilienceConfig),
     }
 
 

@@ -27,43 +27,35 @@ from ..config.schema import ConfigError, LayerConfig, ModelConfig, NetConfig
 from ..layers import Layer, create_layer
 from ..layers.connector import SliceLayer
 from ..params import ParamSpec
+from .kahn import kahn_order
 
 PHASES = ("kTrain", "kValidation", "kTest")
 
 
 def topo_sort(configs: list[LayerConfig]) -> list[LayerConfig]:
     """Kahn's algorithm over srclayers edges, stable wrt config order
-    (the reference DFS-sorts in Graph::Sort, src/utils/graph.cc:80-101)."""
+    (the reference DFS-sorts in Graph::Sort, src/utils/graph.cc:80-101).
+
+    Fail-fast wrapper over the shared core (graph/kahn.py — the same
+    loop lint's report-all cycle pass uses): unknown srclayers and
+    cycles abort the build with ConfigError."""
     by_name = {c.name: c for c in configs}
     if len(by_name) != len(configs):
         names = [c.name for c in configs]
         dupes = sorted({n for n in names if names.count(n) > 1})
         raise ConfigError(f"duplicate layer names after phase filter: {dupes}")
-    indeg = {c.name: 0 for c in configs}
     for c in configs:
         for src in c.srclayers:
             if src not in by_name:
                 raise ConfigError(
                     f"layer {c.name!r} references unknown srclayer {src!r}"
                 )
-            indeg[c.name] += 1
-    order: list[LayerConfig] = []
-    ready = [c for c in configs if indeg[c.name] == 0]
-    while ready:
-        cur = ready.pop(0)
-        order.append(cur)
-        for c in configs:
-            if cur.name in c.srclayers:
-                # per-occurrence: a layer may list the same src twice
-                # (e.g. concat of a layer with itself); indeg counted
-                # every edge, so remove every edge
-                indeg[c.name] -= c.srclayers.count(cur.name)
-                if indeg[c.name] == 0:
-                    ready.append(c)
-    if len(order) != len(configs):
-        stuck = sorted(set(by_name) - {c.name for c in order})
-        raise ConfigError(f"cycle in layer graph involving {stuck}")
-    return order
+    order, residue = kahn_order(
+        [c.name for c in configs], {c.name: c.srclayers for c in configs}
+    )
+    if residue:
+        raise ConfigError(f"cycle in layer graph involving {sorted(residue)}")
+    return [by_name[n] for n in order]
 
 
 class Net:

@@ -58,10 +58,23 @@ _SUPPRESS_RE = re.compile(
     r"#\s*netlint:\s*disable(?:=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?"
 )
 
-#: directories no lint walk descends into — shared by lint_python_tree
-#: and the CLI's path collector so `lint <dir>` and `lint --self` agree
-#: on what gets scanned
+#: directories no lint walk descends into — walk_source_files below is
+#: the one walker that honors it, shared by lint_python_tree and the
+#: CLI's path collector / --self so every entry point agrees on what
+#: gets scanned
 PRUNE_DIRS = frozenset({"__pycache__", ".git"})
+
+
+def walk_source_files(root: str, exts: tuple[str, ...]):
+    """Yield every file under ``root`` with one of the ``exts`` suffixes,
+    pruning PRUNE_DIRS, filenames sorted per directory. The single
+    PRUNE_DIRS-aware tree walk (this used to be hand-copied in three
+    places; ROADMAP correctness-tooling item)."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
+        for fname in sorted(filenames):
+            if fname.endswith(exts):
+                yield os.path.join(dirpath, fname)
 
 #: numpy module aliases whose array constructors force a device->host copy
 _HOST_NP = ("np", "numpy", "onp")
@@ -385,10 +398,7 @@ def lint_python_file(path: str, col: Collector) -> None:
 def lint_python_tree(root: str, col: Collector) -> int:
     """Lint every .py under ``root``; returns the file count."""
     n = 0
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
-        for fname in sorted(filenames):
-            if fname.endswith(".py"):
-                lint_python_file(os.path.join(dirpath, fname), col)
-                n += 1
+    for path in walk_source_files(root, (".py",)):
+        lint_python_file(path, col)
+        n += 1
     return n

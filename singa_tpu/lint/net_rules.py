@@ -27,6 +27,7 @@ from ..config.schema import (
     ModelConfig,
 )
 from ..graph.builder import active_phases
+from ..graph.kahn import kahn_order
 from .core import Collector, ERROR, INFO, WARNING, rule
 
 # ---------------------------------------------------------------------------
@@ -284,26 +285,15 @@ def graph_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
 
 
 def _cycle_members(live, live_names) -> set[str]:
-    """Kahn's algorithm residue = the layers on (or downstream of) a
-    cycle; dangling edges are ignored (NET001 owns those)."""
-    indeg = {
-        l.name: sum(1 for s in l.srclayers if s in live_names) for l in live
-    }
-    ready = [l for l in live if indeg[l.name] == 0]
-    done = 0
-    while ready:
-        cur = ready.pop()
-        done += 1
-        for l in live:
-            if cur.name in l.srclayers:
-                # per-occurrence, like builder.topo_sort: duplicate
-                # edges are counted in indeg, so remove them all
-                indeg[l.name] -= l.srclayers.count(cur.name)
-                if indeg[l.name] == 0:
-                    ready.append(l)
-    if done == len(live):
-        return set()
-    return {name for name, d in indeg.items() if d > 0}
+    """Kahn's-algorithm residue = the layers on (or downstream of) a
+    cycle; dangling edges are ignored (NET001 owns those). The core loop
+    is shared with builder.topo_sort (graph/kahn.py) — this caller keeps
+    only the report-all policy."""
+    del live_names  # kahn_order ignores edges to unknown names itself
+    _, residue = kahn_order(
+        [l.name for l in live], {l.name: l.srclayers for l in live}
+    )
+    return residue
 
 
 # ---------------------------------------------------------------------------

@@ -8,15 +8,22 @@ and grad sync is an XLA collective. -procsID/-hostfile feed
 jax.distributed.initialize (parallel/launch.py) when a multi-host run is
 launched reference-style; on TPU pods the runtime's own environment
 drives the rendezvous and both flags may be omitted.
+
+Jobs run under the resilience supervisor (singa_tpu/resilience/): a
+``resilience { ... }`` config block enables supervised auto-resume from
+the newest complete checkpoint, SIGTERM/SIGINT drain with a resumable
+exit status (75), the divergence guard, and the step watchdog. The
+``-faults`` flag (or SINGA_TPU_FAULTS) injects a deterministic fault
+plan — ``crash@7,sigterm@12,nanloss@5`` — for recovery drills and CI.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from .config import load_cluster_config, load_model_config
-from .trainer import make_trainer
 
 
 def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
@@ -29,12 +36,16 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     ap.add_argument("-hostfile", default=None,
                     help="one host per line; line 0 hosts the rendezvous")
     ap.add_argument("-seed", type=int, default=0, help="init/dropout RNG seed")
+    ap.add_argument(
+        "-faults",
+        default=os.environ.get("SINGA_TPU_FAULTS"),
+        help="deterministic fault plan, e.g. 'crash@7,sigterm@12' "
+        "(resilience/faults.py grammar; also via SINGA_TPU_FAULTS)",
+    )
     return ap.parse_args(argv)
 
 
 def main(argv: list[str] | None = None) -> int:
-    import os
-
     # honor an explicit JAX_PLATFORMS even on images whose sitecustomize
     # pre-pins an accelerator plugin (the env var alone is overridden
     # there) — e.g. JAX_PLATFORMS=cpu for local multi-process fleets
@@ -52,14 +63,15 @@ def main(argv: list[str] | None = None) -> int:
     cluster_cfg = (
         load_cluster_config(args.cluster_conf) if args.cluster_conf else None
     )
-    trainer = make_trainer(model_cfg, cluster_cfg, seed=args.seed)
-    trainer.log(
-        f"training {model_cfg.name!r}: steps "
-        f"[{trainer.start_step}, {model_cfg.train_steps}), "
-        f"batch {trainer.train_net.batchsize}, mesh {dict(trainer.mesh.shape)}"
+    # every job routes through the supervisor: configs without a
+    # resilience block (and no fault plan) take its transparent
+    # single-attempt path; configs with one get auto-resume, preemption
+    # drain (exit 75 = resumable), divergence guard, and the watchdog
+    from .resilience import supervisor
+
+    return supervisor.run(
+        model_cfg, cluster_cfg, seed=args.seed, faults=args.faults
     )
-    trainer.run()
-    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,63 @@
+"""Fault-tolerance runtime: supervised auto-resume, preemption-safe
+checkpointing, a divergence guard, and deterministic fault injection.
+
+The reference's availability story was structural — a parameter-server
+tier held the global model so a restarted worker group could rejoin
+(src/main.cc:49-55), and Worker::Resume was a declared-but-empty TODO
+(src/worker/worker.cc:65-67). singa-tpu has no server tier, so the
+obligation moves into this trainer-side resilience layer:
+
+  supervisor.py   the supervised train loop (crash -> restore newest
+                  complete checkpoint -> bounded-backoff retry ->
+                  crash-loop circuit breaker)
+  retention.py    keep-last-N + atomic LATEST marker + torn-save defense
+  preemption.py   SIGTERM/SIGINT -> drain -> final checkpoint ->
+                  resumable exit code (EXIT_RESUMABLE, 75)
+  guard.py        on-device loss/grad-norm finiteness verdict with
+                  skip / rollback-with-LR-backoff policies — zero
+                  per-step host syncs
+  watchdog.py     step-wall-clock watchdog (hung-collective detection)
+  faults.py       the deterministic fault plan (``crash@7,...``) that
+                  lets tests PROVE end-to-end recovery
+  context.py      ResilienceContext — what the trainer's step-boundary
+                  seams actually call
+
+Config: the ``resilience { ... }`` block (config/schema.py
+ResilienceConfig); CLI: ``-faults`` / ``SINGA_TPU_FAULTS`` on
+``python -m singa_tpu.main``, which routes every job through the
+supervisor. ``supervisor`` itself is imported lazily (it pulls in the
+trainer package) — use ``from singa_tpu.resilience import supervisor``.
+"""
+
+from .context import ResilienceContext  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultPlan,
+    FaultPlanError,
+    FaultSpec,
+    InjectedCrash,
+)
+from .guard import (  # noqa: F401
+    GUARD_BAD,
+    GUARD_CONSEC,
+    GUARD_KEYS,
+    GUARD_LR,
+    GuardGaveUp,
+    GuardSpec,
+    init_guard_buffers,
+)
+from .preemption import (  # noqa: F401
+    EXIT_FAILED,
+    EXIT_OK,
+    EXIT_RESUMABLE,
+    PreemptionDrained,
+    PreemptionHandler,
+)
+from .retention import (  # noqa: F401
+    LATEST_MARKER,
+    apply_retention,
+    gc_stale_shards,
+    mark_latest,
+    resolve_latest,
+    validate_checkpoint,
+)
+from .watchdog import Watchdog  # noqa: F401

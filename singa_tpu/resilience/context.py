@@ -1,0 +1,271 @@
+"""ResilienceContext: the object the trainer's step-boundary seams call.
+
+One context lives for a whole supervised job (across restart attempts —
+that is what makes the fault plan fire-once and the watchdog/preemption
+state coherent). The trainer holds it as ``trainer.resilience`` and
+calls exactly four seams, all host-side, all outside jitted code:
+
+  before_step(trainer, step)    watchdog heartbeat; crash/sigterm/
+                                slowstep fault injection; preemption
+                                drain (save + PreemptionDrained)
+  after_step(trainer, step)     guard rollback policy (counter read at
+                                most once per rollback window — never
+                                per step); returns the possibly
+                                rolled-back step to continue from
+  inject_batch_faults(...)      nanloss poisoning of one step's batch
+  checkpoint_written(...)       corrupt_ckpt fault; validation; LATEST
+                                marking; keep-last-N retention
+
+A trainer with ``resilience = None`` (the default) skips all of it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax.numpy as jnp
+
+from ..config.schema import ResilienceConfig
+from . import retention
+from .faults import FaultPlan, InjectedCrash
+from .guard import GUARD_CONSEC, GUARD_LR, GuardGaveUp
+from .preemption import PreemptionDrained, PreemptionHandler
+from .watchdog import Watchdog
+
+
+class ResilienceContext:
+    def __init__(
+        self,
+        res_cfg: ResilienceConfig | None = None,
+        plan: FaultPlan | None = None,
+        log=print,
+    ):
+        self.cfg = res_cfg if res_cfg is not None else ResilienceConfig()
+        self.plan = plan if plan is not None else FaultPlan()
+        self.log = log
+        self.preemption = PreemptionHandler()
+        self.watchdog = Watchdog(self.cfg.watchdog_timeout, log)
+        #: <workspace>/checkpoints, once a trainer with a workspace binds
+        self.ckpt_dir: str | None = None
+        #: 1-based ordinal of checkpoint saves (corrupt_ckpt@K keys on it)
+        self.save_ordinal = 0
+        self._last_guard_check = -(10**9)
+        #: rollback livelock defense: consecutive rollbacks that never
+        #: got past the step that tripped the previous one
+        self._stuck_rollbacks = 0
+        self._rollback_high_step = -1
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    @property
+    def per_step(self) -> bool:
+        """Fault plans need exact step boundaries: the trainer disables
+        multi-step chunking for the whole drill (fired or not — a drill
+        run stays deterministic over chunk throughput)."""
+        return bool(self.plan)
+
+    def bind(self, trainer) -> None:
+        """Attach to a (possibly restarted) trainer instance."""
+        trainer.resilience = self
+        self.ckpt_dir = trainer._checkpoint_dir()
+        self.watchdog.beat(trainer.start_step)
+        self.watchdog.start()
+
+    def stop(self) -> None:
+        self.watchdog.stop()
+
+    # ------------------------------------------------------------------
+    # step-boundary seams
+    # ------------------------------------------------------------------
+
+    def before_step(self, trainer, step: int) -> None:
+        self.watchdog.beat(step)
+        spec = self.plan.fire("slowstep", step)
+        if spec is not None:
+            dur = 1.0 if spec.value is None else spec.value
+            self.log(f"FAULT: slowstep@{step} — stalling {dur:g}s")
+            time.sleep(dur)
+        spec = self.plan.fire("sigterm", step)
+        if spec is not None:
+            self.log(f"FAULT: sigterm@{step} — synthetic SIGTERM")
+            self.preemption.trigger(f"injected sigterm@{step}")
+        spec = self.plan.fire("crash", step)
+        if spec is not None:
+            self.log(f"FAULT: crash@{step} — raising InjectedCrash")
+            raise InjectedCrash(f"injected crash@{step}")
+        if self.preemption.requested:
+            self._drain(trainer, step)
+
+    def _drain(self, trainer, step: int) -> None:
+        """Write the final checkpoint and leave with resumable status.
+        Called at a step boundary, so nothing is in flight — the current
+        step/chunk has fully drained."""
+        path = None
+        if self.cfg.preemption_checkpoint:
+            path = trainer.save(step)
+        where = (
+            f", final checkpoint {path}"
+            if path
+            else ", no workspace configured — state not checkpointed"
+        )
+        self.log(
+            f"PREEMPTION: {self.preemption.reason} — drained at "
+            f"step {step}{where}; exiting resumable"
+        )
+        raise PreemptionDrained(step, path)
+
+    def after_step(self, trainer, step: int) -> int:
+        """Guard rollback policy. The counter read is a host sync, so it
+        runs at most once per rollback window (and once at the end of
+        the run), never per step."""
+        self.watchdog.beat(step)
+        g = trainer._guard
+        if g is None or g.policy != "kRollback":
+            return step
+        due = step - self._last_guard_check >= g.rollback_after
+        if not due and step < trainer.cfg.train_steps:
+            return step
+        self._last_guard_check = step
+        consec = int(trainer.buffers[GUARD_CONSEC])
+        if consec < g.rollback_after:
+            return step
+        return self._rollback(trainer, step, consec)
+
+    def _rollback(self, trainer, step: int, consec: int) -> int:
+        g = trainer._guard
+        # livelock defense: a rollback restores params, stream
+        # positions, AND the per-step RNG folds exactly — a
+        # deterministic divergence (NaN baked into the data) replays
+        # identically no matter how far the LR backs off. Rolling back
+        # again without ever getting PAST the previous trigger step can
+        # therefore never converge; give up loudly instead of burning
+        # the reservation in silence.
+        if step > self._rollback_high_step:
+            self._stuck_rollbacks = 1
+        else:
+            self._stuck_rollbacks += 1
+        self._rollback_high_step = max(self._rollback_high_step, step)
+        limit = max(2, self.cfg.max_restarts)
+        if self._stuck_rollbacks > limit:
+            raise GuardGaveUp(
+                f"{self._stuck_rollbacks} rollbacks without progress "
+                f"past step {self._rollback_high_step} — the divergence "
+                "replays deterministically; refusing to livelock"
+            )
+        new_scale = float(trainer.buffers[GUARD_LR]) * g.lr_backoff
+        path = retention.resolve_latest(self.ckpt_dir)
+        if path is None:
+            self.log(
+                f"GUARD: {consec} consecutive bad steps at step {step} "
+                "but no checkpoint to roll back to — resetting the "
+                f"counter and backing the LR scale off to {new_scale:g}"
+            )
+            trainer.set_guard_state(consec=0, lr_scale=new_scale)
+            return step
+        self.log(
+            f"GUARD: {consec} consecutive bad steps at step {step} — "
+            f"rolling back to {path}, LR scale -> {new_scale:g}"
+        )
+        rolled = trainer.rollback_to(path)
+        trainer.set_guard_state(consec=0, lr_scale=new_scale)
+        # re-arm the window from the rollback point so the next check
+        # happens a full window after training resumes
+        self._last_guard_check = rolled
+        return rolled
+
+    def inject_batch_faults(self, trainer, step: int, batch: dict) -> dict:
+        """nanloss@step: poison the batch with NaN images (labels keep
+        their values). Device-cached ``__idx__`` feeds are materialized
+        to direct feeds first — the poisoned step takes the plain path."""
+        if self.plan.fire("nanloss", step) is None:
+            return batch
+        self.log(f"FAULT: nanloss@{step} — poisoning the step's batch")
+        out = {}
+        for name, feed in batch.items():
+            if "__idx__" in feed:
+                idx = feed["__idx__"]
+                shape = (int(idx.shape[0]),) + tuple(feed["image"].shape[1:])
+                labels = jnp.take(feed["label"], idx, axis=0)
+            else:
+                shape = tuple(feed["image"].shape)
+                labels = feed["label"]
+            out[name] = {
+                "image": jnp.full(shape, jnp.nan, dtype=jnp.float32),
+                "label": labels,
+            }
+        return out
+
+    # ------------------------------------------------------------------
+    # checkpoint hook
+    # ------------------------------------------------------------------
+
+    def checkpoint_written(self, trainer, path: str, step: int) -> None:
+        del trainer, step
+        self.save_ordinal += 1
+        spec = self.plan.fire("corrupt_ckpt", self.save_ordinal)
+        if spec is not None:
+            self._corrupt(path)
+            self.log(
+                f"FAULT: corrupt_ckpt@{self.save_ordinal} — tore {path}"
+            )
+        # validation, LATEST, and retention are process 0's job alone:
+        # every process racing rmtree/marker writes on the same dir
+        # would be chaos. (Real cross-process save barriers are a
+        # ROADMAP item; until then process 0 polls briefly for the
+        # peers' shard files before judging a sharded save torn.)
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        if os.path.isdir(path):
+            self._await_peer_shards(path)
+        folder = os.path.dirname(path)
+        if retention.validate_checkpoint(path):
+            retention.mark_latest(folder, path)
+        else:
+            self.log(
+                f"WARNING: checkpoint {path} failed validation — "
+                "LATEST keeps pointing at the previous complete save"
+            )
+        if self.cfg.keep_last > 0:
+            for gone in retention.apply_retention(folder, self.cfg.keep_last):
+                self.log(f"retention: removed {gone}")
+
+    @staticmethod
+    def _await_peer_shards(path: str, timeout: float = 10.0) -> None:
+        """Bounded wait for every manifest-promised proc_k.npz: peer
+        processes write their shards concurrently with process 0's
+        manifest, so 'missing' usually means 'still in flight', not
+        'torn'. Validation after the wait still catches real tears."""
+        import json
+
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                nprocs = int(json.load(f).get("nprocs", 1))
+        except (OSError, ValueError):
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(
+                os.path.exists(os.path.join(path, f"proc_{k}.npz"))
+                for k in range(nprocs)
+            ):
+                return
+            time.sleep(0.05)
+
+    @staticmethod
+    def _corrupt(path: str) -> None:
+        """Simulate a torn write: truncate the save to half its bytes
+        (the shard file, for sharded dirs)."""
+        target = path
+        if os.path.isdir(path):
+            target = os.path.join(path, "proc_0.npz")
+        try:
+            size = os.path.getsize(target)
+            with open(target, "r+b") as f:
+                f.truncate(max(1, size // 2))
+        except OSError:
+            pass

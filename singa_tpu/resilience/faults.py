@@ -1,0 +1,119 @@
+"""Deterministic fault injection: the proof harness for recovery paths.
+
+A fault plan is a comma-separated list of ``kind@at`` terms (optionally
+``kind@at=value``), parsed from the ``-faults`` CLI flag or the
+``SINGA_TPU_FAULTS`` env var:
+
+  crash@7          raise InjectedCrash at the step-7 boundary (before the
+                   step runs) — exercises supervisor auto-resume
+  sigterm@12       deliver a synthetic SIGTERM at the step-12 boundary —
+                   exercises the preemption drain + resumable exit
+  nanloss@5        poison step 5's batch with NaN — exercises the
+                   divergence guard (skip / rollback policies)
+  corrupt_ckpt@1   truncate the 1st checkpoint written (ordinal, 1-based,
+                   between the save and the LATEST mark) — exercises
+                   torn-save detection in the retention module
+  slowstep@9=0.5   sleep 0.5 s at the step-9 boundary — exercises the
+                   step-wall-clock watchdog
+
+Every fault fires exactly once per plan object. The supervisor owns ONE
+plan across all restart attempts, so ``crash@7`` does not re-fire after
+the auto-resumed run passes step 7 again — which is what makes
+end-to-end recovery *testable* instead of merely asserted. Injection
+happens at the trainer's step-boundary seams (trainer.py run loop /
+train_one_batch / save), never inside jitted code, so a faulted run's
+device programs are bit-identical to a clean run's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class FaultPlanError(ValueError):
+    """The -faults string does not match the plan grammar."""
+
+
+class InjectedCrash(RuntimeError):
+    """The failure a ``crash@N`` fault raises at its step boundary."""
+
+
+KINDS = ("crash", "sigterm", "nanloss", "corrupt_ckpt", "slowstep")
+
+#: kinds triggered by step number at the pre-step boundary seam
+STEP_KINDS = ("crash", "sigterm", "slowstep")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One ``kind@at[=value]`` term; ``fired`` flips on injection."""
+
+    kind: str
+    at: int
+    value: float | None = None
+    fired: bool = False
+
+    def __str__(self) -> str:
+        v = "" if self.value is None else f"={self.value:g}"
+        return f"{self.kind}@{self.at}{v}"
+
+
+class FaultPlan:
+    """A parsed, once-each fault schedule shared across restart attempts."""
+
+    def __init__(self, specs: list[FaultSpec] | None = None):
+        self.specs = list(specs or [])
+
+    @classmethod
+    def parse(cls, text: str | None) -> "FaultPlan":
+        specs: list[FaultSpec] = []
+        for term in (text or "").split(","):
+            term = term.strip()
+            if not term:
+                continue
+            head, sep, val = term.partition("=")
+            kind, sep2, at = head.partition("@")
+            if not sep2:
+                raise FaultPlanError(
+                    f"fault term {term!r}: expected kind@step"
+                )
+            if kind not in KINDS:
+                raise FaultPlanError(
+                    f"fault term {term!r}: unknown kind {kind!r} "
+                    f"(known: {', '.join(KINDS)})"
+                )
+            try:
+                at_n = int(at)
+            except ValueError:
+                raise FaultPlanError(
+                    f"fault term {term!r}: step {at!r} is not an integer"
+                ) from None
+            if at_n < 0:
+                raise FaultPlanError(f"fault term {term!r}: negative step")
+            value = None
+            if sep:
+                try:
+                    value = float(val)
+                except ValueError:
+                    raise FaultPlanError(
+                        f"fault term {term!r}: value {val!r} is not a number"
+                    ) from None
+            specs.append(FaultSpec(kind, at_n, value))
+        return cls(specs)
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def fire(self, kind: str, at: int) -> FaultSpec | None:
+        """The unfired ``kind@at`` spec, marked fired — or None."""
+        for spec in self.specs:
+            if spec.kind == kind and spec.at == at and not spec.fired:
+                spec.fired = True
+                return spec
+        return None
+
+    def unfired(self) -> list[FaultSpec]:
+        return [s for s in self.specs if not s.fired]
+
+    def __str__(self) -> str:
+        return ",".join(str(s) for s in self.specs) or "<empty>"

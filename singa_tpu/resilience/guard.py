@@ -1,0 +1,109 @@
+"""Divergence guard: on-device bad-step detection, host-side policy.
+
+The detection half lives INSIDE the jitted train step (trainer.py
+``_train_step_fn``): one fused finiteness verdict over the step's loss
+and global grad-norm, folded into the step's own outputs — the guard
+counters ride the buffer pytree the step already threads, so a guarded
+run does exactly as many host syncs as an unguarded one (none per step;
+self-lint's JAX-hazard pass stays clean).
+
+Policies (ResilienceConfig.guard_policy):
+
+  kSkip      a bad step's param/state/buffer updates are dropped on
+             device (``where(ok, new, old)``) and the bad-step counters
+             increment; training continues on the pre-step state.
+  kRollback  kSkip, plus: when ``guard_rollback_after`` consecutive
+             steps are bad, the host (checking the counter only at
+             step-boundary cadence, resilience/context.py) restores the
+             last complete checkpoint and backs the effective LR off by
+             ``guard_lr_backoff`` — the accumulated scale multiplies the
+             gradients inside the step, so the backoff also needs no
+             recompile and no host sync.
+
+The counters live in the buffers dict under reserved dunder keys, so
+they checkpoint/restore with the rest of training state for free.
+Supported on the backprop engine (the base Trainer step); the CD and
+replica engines override the step body and reject guard configs loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+class GuardGaveUp(RuntimeError):
+    """kRollback rolled back repeatedly without getting past the step
+    that tripped it — the divergence is deterministic (e.g. NaN baked
+    into the data stream), so replaying the same checkpoint + stream
+    positions can never succeed. Raised instead of livelooping; the
+    supervisor treats it like any crash and its circuit breaker gives
+    up loudly."""
+
+
+#: reserved buffer keys (never collide with layer buffers, which are
+#: namespaced by layer name)
+GUARD_CONSEC = "__guard_consec__"  # consecutive bad steps (int32)
+GUARD_BAD = "__guard_bad__"  # total bad steps this run (int32)
+GUARD_LR = "__guard_lr_scale__"  # accumulated LR backoff (float32)
+GUARD_KEYS = (GUARD_CONSEC, GUARD_BAD, GUARD_LR)
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardSpec:
+    """The trainer-facing slice of ResilienceConfig's guard fields."""
+
+    policy: str  # "kSkip" | "kRollback"
+    rollback_after: int
+    lr_backoff: float
+
+    @staticmethod
+    def from_config(res_cfg) -> "GuardSpec | None":
+        """-> GuardSpec, or None when no guard is configured."""
+        if res_cfg is None or res_cfg.guard_policy == "kNone":
+            return None
+        return GuardSpec(
+            policy=res_cfg.guard_policy,
+            rollback_after=max(1, res_cfg.guard_rollback_after),
+            lr_backoff=res_cfg.guard_lr_backoff,
+        )
+
+
+def init_guard_buffers() -> dict[str, jnp.ndarray]:
+    """Fresh counters for a guarded run (merged into init buffers, so
+    they persist through checkpoints like any other buffer)."""
+    return {
+        GUARD_CONSEC: jnp.int32(0),
+        GUARD_BAD: jnp.int32(0),
+        GUARD_LR: jnp.float32(1.0),
+    }
+
+
+def grad_norm_sq(grads) -> jnp.ndarray:
+    """Global squared grad-norm, accumulated in fp32 (a single scalar —
+    NaN/Inf anywhere in any gradient poisons it, which is the point)."""
+    total = jnp.float32(0.0)
+    for g in jax.tree.leaves(grads):
+        total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return total
+
+
+def apply_verdict(ok, new_tree, old_tree):
+    """``where(ok, new, old)`` over a pytree — the on-device skip."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+    )
+
+
+def step_guard_buffers(ok, buffers) -> dict[str, jnp.ndarray]:
+    """The post-step guard counters (same dtypes as init, so the chunk
+    engine's lax.scan carry stays fixed-shape)."""
+    bad = (~ok).astype(jnp.int32)
+    return {
+        GUARD_CONSEC: jnp.where(
+            ok, jnp.int32(0), buffers[GUARD_CONSEC] + 1
+        ).astype(jnp.int32),
+        GUARD_BAD: (buffers[GUARD_BAD] + bad).astype(jnp.int32),
+        GUARD_LR: buffers[GUARD_LR],
+    }

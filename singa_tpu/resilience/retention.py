@@ -1,0 +1,215 @@
+"""Checkpoint retention: keep-last-N, a LATEST marker, torn-save defense.
+
+The trainer writes ``step_<N>.npz`` files (or ``step_<N>.ckpt`` sharded
+dirs) into ``<workspace>/checkpoints``; this module decides which of
+them to trust and which to keep:
+
+  - ``mark_latest`` records the newest *validated* checkpoint in a
+    ``LATEST`` marker file, written atomically (tmp + rename) so the
+    marker itself can never be torn. The caller validates BEFORE
+    marking, so LATEST never points at a torn or corrupt save.
+  - ``resolve_latest`` is the restore-side mirror: follow LATEST when
+    its target validates, else fall back to scanning every ``step_*``
+    entry newest-first and return the first complete one. A job whose
+    final save was cut mid-write resumes from the save before it
+    instead of crashing on garbage.
+  - ``validate_checkpoint`` is the completeness check both sides use:
+    npz files must be intact zip archives holding the step key; sharded
+    dirs must hold a parseable manifest plus every ``proc_k`` shard the
+    manifest promises (CRC-checked) — a torn multi-process save or a
+    stale dir from a differently-sized job fails here, loudly.
+  - ``apply_retention`` garbage-collects all but the newest N complete
+    checkpoints (never the one LATEST names).
+  - ``gc_stale_shards`` removes ``proc_k.npz`` files a previously larger
+    job left behind in a sharded dir (k >= the manifest's nprocs) —
+    save_sharded now prevents new ones, this cleans up old dirs.
+
+No imports from the trainer package: the supervisor calls this before a
+trainer exists, and the trainer's save hook calls it after each write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import zipfile
+
+LATEST_MARKER = "LATEST"
+
+_STEP_RE = re.compile(r"^step_(\d+)\.(npz|ckpt)$")
+_PROC_RE = re.compile(r"^proc_(\d+)\.npz$")
+
+
+def checkpoint_step(path: str) -> int | None:
+    """The step number encoded in a checkpoint basename, or None."""
+    m = _STEP_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _npz_valid(path: str) -> bool:
+    """Intact zip archive holding the ``__step__`` entry. ``testzip``
+    CRC-checks every member, so a truncated or bit-flipped save fails
+    even though np.load's lazy zip layer might open it."""
+    try:
+        with zipfile.ZipFile(path) as z:
+            if z.testzip() is not None:
+                return False
+            return any(n.startswith("__step__") for n in z.namelist())
+    except (OSError, zipfile.BadZipFile, ValueError):
+        return False
+
+
+def _sharded_valid(path: str) -> bool:
+    """Manifest parses and every promised proc shard is an intact zip."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if manifest.get("format") != "singa-tpu-sharded-v1":
+        return False
+    nprocs = int(manifest.get("nprocs", 1))
+    for k in range(nprocs):
+        shard = os.path.join(path, f"proc_{k}.npz")
+        try:
+            with zipfile.ZipFile(shard) as z:
+                if z.testzip() is not None:
+                    return False
+        except (OSError, zipfile.BadZipFile, ValueError):
+            return False
+    return True
+
+
+def validate_checkpoint(path: str) -> bool:
+    """True iff ``path`` is a complete, readable checkpoint."""
+    if os.path.isdir(path):
+        return _sharded_valid(path)
+    return os.path.isfile(path) and _npz_valid(path)
+
+
+def list_checkpoints(folder: str) -> list[str]:
+    """``step_*`` entries under ``folder``, newest step first (no
+    validation — callers validate the ones they intend to trust)."""
+    try:
+        names = os.listdir(folder)
+    except OSError:
+        return []
+    found = []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(folder, name)))
+    return [p for _, p in sorted(found, reverse=True)]
+
+
+def mark_latest(folder: str, path: str) -> None:
+    """Atomically point ``folder/LATEST`` at ``path`` (a checkpoint in
+    ``folder``). Callers must have validated ``path`` first — the marker
+    is the trust anchor a restarted job follows blindly."""
+    marker = os.path.join(folder, LATEST_MARKER)
+    tmp = marker + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(os.path.basename(path) + "\n")
+    os.replace(tmp, marker)
+
+
+def resolve_latest(folder: str | None) -> str | None:
+    """The newest complete checkpoint under ``folder``: the LATEST
+    target when it validates, else the newest ``step_*`` entry that
+    does; None when nothing trustworthy exists."""
+    if not folder or not os.path.isdir(folder):
+        return None
+    marker = os.path.join(folder, LATEST_MARKER)
+    try:
+        with open(marker, "r", encoding="utf-8") as f:
+            name = f.read().strip()
+    except OSError:
+        name = ""
+    if name:
+        target = os.path.join(folder, os.path.basename(name))
+        if validate_checkpoint(target):
+            return target
+    for path in list_checkpoints(folder):
+        if validate_checkpoint(path):
+            return path
+    return None
+
+
+def apply_retention(folder: str, keep_last: int) -> list[str]:
+    """Delete all but the newest ``keep_last`` complete checkpoints
+    (invalid ones are deleted regardless — they can never be restored
+    — except the newest entry, which may still be mid-write by a
+    concurrent saver). The LATEST target always survives. Returns the
+    deleted paths. ``keep_last <= 0`` keeps everything."""
+    if keep_last <= 0:
+        return []
+    marker = os.path.join(folder, LATEST_MARKER)
+    pinned = ""
+    try:
+        with open(marker, "r", encoding="utf-8") as f:
+            pinned = f.read().strip()
+    except OSError:
+        pass
+    deleted: list[str] = []
+    kept = 0
+    for i, path in enumerate(list_checkpoints(folder)):
+        valid = validate_checkpoint(path)
+        keep = (valid and kept < keep_last) or (
+            pinned and os.path.basename(path) == pinned
+        )
+        if not valid and i == 0:
+            keep = True  # newest entry may be a concurrent in-flight save
+        if keep:
+            kept += int(valid)
+            continue
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+            deleted.append(path)
+        except OSError:
+            pass
+    return deleted
+
+
+def remove_stale_shards(path: str, nprocs: int) -> list[str]:
+    """Remove ``proc_k.npz`` (and torn ``.tmp``) files in a sharded
+    checkpoint dir for k >= ``nprocs`` — leftovers from a previously
+    larger job that the loader would silently never read. The ONE copy
+    of this delete loop: ``save_sharded`` calls it with the live
+    process count before writing its manifest, ``gc_stale_shards``
+    with the manifest's own count for already-written dirs. Files for
+    k < nprocs are never touched (a peer process may be mid-write)."""
+    removed = []
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return removed
+    for fname in names:
+        base = fname[:-4] if fname.endswith(".tmp") else fname
+        m = _PROC_RE.match(base)
+        if m and int(m.group(1)) >= nprocs:
+            full = os.path.join(path, fname)
+            try:
+                os.unlink(full)
+                removed.append(full)
+            except OSError:
+                pass
+    return removed
+
+
+def gc_stale_shards(path: str) -> list[str]:
+    """``remove_stale_shards`` driven by the manifest's own nprocs —
+    cleans dirs written before save_sharded grew its at-save GC.
+    Returns the removed paths; no-op for npz checkpoints."""
+    if not os.path.isdir(path):
+        return []
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            nprocs = int(json.load(f).get("nprocs", 1))
+    except (OSError, ValueError):
+        return []
+    return remove_stale_shards(path, nprocs)

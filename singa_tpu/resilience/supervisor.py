@@ -1,0 +1,165 @@
+"""The supervised train loop: crash -> restore newest checkpoint -> retry.
+
+``run()`` owns the whole training lifetime the way the reference's
+parameter-server tier owned model state (src/main.cc:49-55 — a restarted
+worker group could rejoin and refetch): with no server tier, the
+supervisor is the trainer-side replacement. Per attempt it locates the
+newest *complete* checkpoint (resilience/retention.py — the LATEST
+marker, falling back over torn saves), points the model config at it,
+rebuilds the trainer, and runs. Failures restart with bounded
+exponential backoff; a crash-loop circuit breaker gives up loudly after
+``max_restarts`` consecutive failures that each made less than
+``restart_window_steps`` steps of progress. SIGTERM/SIGINT surface as
+``PreemptionDrained`` (state already checkpointed) and exit with the
+distinct resumable status code so launchers can tell "relaunch me" from
+"debug me".
+
+Jobs with no ``resilience`` config block and no fault plan take a
+transparent single-attempt path — exactly the pre-supervisor behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..config.schema import ModelConfig, ResilienceConfig
+from . import retention
+from .context import ResilienceContext
+from .faults import FaultPlan
+from .guard import GuardGaveUp
+from .preemption import (
+    EXIT_OK,
+    EXIT_RESUMABLE,
+    PreemptionDrained,
+)
+
+
+def _banner(trainer, model_cfg: ModelConfig) -> None:
+    trainer.log(
+        f"training {model_cfg.name!r}: steps "
+        f"[{trainer.start_step}, {model_cfg.train_steps}), "
+        f"batch {trainer.train_net.batchsize}, mesh {dict(trainer.mesh.shape)}"
+    )
+
+
+def run(
+    model_cfg: ModelConfig,
+    cluster_cfg=None,
+    *,
+    seed: int = 0,
+    faults: str | FaultPlan | None = None,
+    log=print,
+    trainer_factory=None,
+    **trainer_kwargs,
+) -> int:
+    """Train ``model_cfg`` to completion under supervision; returns the
+    process exit code (EXIT_OK / EXIT_RESUMABLE). A crash the circuit
+    breaker refuses to retry propagates — loudly — to the caller."""
+    if trainer_factory is None:
+        from ..trainer import make_trainer as trainer_factory
+    plan = (
+        faults if isinstance(faults, FaultPlan) else FaultPlan.parse(faults)
+    )
+    trainer_kwargs.setdefault("log", log)
+
+    res = model_cfg.resilience
+    if res is None and not plan:
+        # unsupervised jobs keep their exact pre-supervisor behavior
+        trainer = trainer_factory(
+            model_cfg, cluster_cfg, seed=seed, **trainer_kwargs
+        )
+        _banner(trainer, model_cfg)
+        trainer.run()
+        return EXIT_OK
+
+    if res is None:
+        res = ResilienceConfig()
+    ctx = ResilienceContext(res, plan, log=log)
+    if not ctx.preemption.install():
+        log(
+            "resilience: cannot install signal handlers (not the main "
+            "thread) — synthetic/injected preemption only"
+        )
+    ckpt_dir = None
+    if cluster_cfg is not None and cluster_cfg.workspace:
+        ckpt_dir = os.path.join(cluster_cfg.workspace, "checkpoints")
+    configured_ckpt = model_cfg.checkpoint
+    failures = 0  # consecutive low-progress failures (the breaker's count)
+    attempt = 0
+    try:
+        while True:
+            attempt += 1
+            # auto-resume: the newest complete checkpoint beats the
+            # config's warm-start path; a torn/corrupt newest save falls
+            # back to the one before it (retention.resolve_latest)
+            latest = retention.resolve_latest(ckpt_dir)
+            model_cfg.checkpoint = latest or configured_ckpt
+            trainer = None
+            try:
+                trainer = trainer_factory(
+                    model_cfg, cluster_cfg, seed=seed, **trainer_kwargs
+                )
+                ctx.bind(trainer)
+                _banner(trainer, model_cfg)
+                trainer.run()
+                log(
+                    f"supervisor: training complete at step "
+                    f"{model_cfg.train_steps} (attempt {attempt})"
+                )
+                return EXIT_OK
+            except PreemptionDrained as e:
+                log(
+                    f"supervisor: preempted at step {e.step} — "
+                    f"exiting resumable (status {EXIT_RESUMABLE})"
+                )
+                return EXIT_RESUMABLE
+            except GuardGaveUp as e:
+                # a deterministic divergence replays identically after
+                # every restore — restarting would loop forever (each
+                # attempt makes nominal step progress before tripping,
+                # so the breaker alone would keep re-arming)
+                log(
+                    f"supervisor: GIVING UP — divergence guard declared "
+                    f"the failure unrecoverable ({e}); not restarting"
+                )
+                raise
+            except Exception as e:  # the supervisor survives ANY crash
+                start = trainer.start_step if trainer is not None else 0
+                done = (
+                    getattr(trainer, "completed_steps", start)
+                    if trainer is not None
+                    else start
+                )
+                progress = max(0, done - start)
+                window = max(1, res.restart_window_steps)
+                if progress >= window:
+                    failures = 0  # real progress re-arms the breaker
+                failures += 1
+                log(
+                    f"supervisor: attempt {attempt} died at step {done} "
+                    f"({type(e).__name__}: {e}); {progress} step(s) of "
+                    "progress since restore"
+                )
+                if failures > res.max_restarts:
+                    log(
+                        "supervisor: GIVING UP — "
+                        f"{failures} failure(s), each with fewer than "
+                        f"{window} step(s) of progress "
+                        f"(max_restarts {res.max_restarts}); re-raising"
+                    )
+                    raise
+                delay = min(
+                    res.backoff_max,
+                    res.backoff_base * (2 ** (failures - 1)),
+                )
+                log(
+                    f"supervisor: restart {failures}/{res.max_restarts} "
+                    f"in {delay:g}s"
+                )
+                if delay > 0:
+                    time.sleep(delay)
+    finally:
+        ctx.stop()
+        ctx.preemption.uninstall()
+        model_cfg.checkpoint = configured_ckpt

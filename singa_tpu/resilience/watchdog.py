@@ -1,0 +1,83 @@
+"""Step-wall-clock watchdog: hung-collective detection.
+
+A multi-host collective that loses a peer does not crash — it hangs, and
+the job burns its reservation in silence. The watchdog is a host-side
+daemon thread fed a heartbeat at every step/chunk boundary; when the gap
+since the last beat exceeds the configured timeout it dumps diagnostics
+(the stalled step number, the elapsed time, and every thread's Python
+stack) through the job log, once per stall. It never kills anything —
+the operator (or an external supervisor watching the log) decides;
+killing from a watchdog thread would turn a transient straggler into a
+guaranteed restart.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+
+class Watchdog:
+    """Monitor thread: ``beat(step)`` at boundaries, dump on stall."""
+
+    def __init__(self, timeout: float, log=print):
+        self.timeout = float(timeout)
+        self.log = log
+        self._lock = threading.Lock()
+        self._last_beat = time.monotonic()
+        self._last_step = -1
+        self._dumped_for = -2  # step already diagnosed (once per stall)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: stall dumps emitted (tests and post-mortems read this)
+        self.stalls = 0
+
+    def start(self) -> None:
+        if self.timeout <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, name="singa-tpu-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def beat(self, step: int) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._last_step = step
+
+    def _watch(self) -> None:
+        # poll fast enough to catch a stall promptly without busy-waiting
+        poll = max(0.01, min(self.timeout / 4.0, 1.0))
+        while not self._stop.wait(poll):
+            with self._lock:
+                elapsed = time.monotonic() - self._last_beat
+                step, dumped = self._last_step, self._dumped_for
+            if elapsed <= self.timeout or step == dumped:
+                continue
+            self._dump(step, elapsed)
+            with self._lock:
+                self._dumped_for = step
+                self.stalls += 1
+
+    def _dump(self, step: int, elapsed: float) -> None:
+        lines = [
+            f"WATCHDOG: step {step} has run {elapsed:.1f}s "
+            f"(timeout {self.timeout:.1f}s) — possible hung collective "
+            "or straggler; thread stacks follow"
+        ]
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in sys._current_frames().items():
+            if names.get(ident) == "singa-tpu-watchdog":
+                continue
+            lines.append(f"--- thread {names.get(ident, ident)} ---")
+            lines.append("".join(traceback.format_stack(frame)).rstrip())
+        self.log("\n".join(lines))

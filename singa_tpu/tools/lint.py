@@ -36,7 +36,7 @@ from ..lint import (
     render_text,
     sharding_rules_static,
 )
-from ..lint.ast_rules import PRUNE_DIRS
+from ..lint.ast_rules import walk_source_files
 from ..lint.net_rules import CFG000
 from ..lint.shape_rules import shape_pass
 
@@ -92,14 +92,8 @@ def _collect(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
     missing: list[str] = []
     for p in paths:
         if os.path.isdir(p):
-            for dirpath, dirnames, filenames in os.walk(p):
-                dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
-                for f in sorted(filenames):
-                    full = os.path.join(dirpath, f)
-                    if f.endswith(".conf"):
-                        confs.append(full)
-                    elif f.endswith(".py"):
-                        pys.append(full)
+            for full in walk_source_files(p, (".conf", ".py")):
+                (confs if full.endswith(".conf") else pys).append(full)
         elif os.path.isfile(p):
             (confs if not p.endswith(".py") else pys).append(p)
         else:
@@ -181,11 +175,7 @@ def main(argv: list[str] | None = None) -> int:
         _lint_conf(path, col, widths)
     if args.self_lint:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        for dirpath, dirnames, filenames in os.walk(pkg_root):
-            dirnames[:] = [d for d in dirnames if d not in PRUNE_DIRS]
-            for f in sorted(filenames):
-                if f.endswith(".py"):
-                    pys.append(os.path.join(dirpath, f))
+        pys.extend(walk_source_files(pkg_root, (".py",)))
     # `lint singa_tpu/ --self` must not report every finding twice
     seen_py: set[str] = set()
     for path in pys:

@@ -109,6 +109,16 @@ def save_sharded(
     os.replace(shard_file + ".tmp", shard_file)
 
     if proc == 0:
+        # a re-save into a dir written by a LARGER job must not leave
+        # proc_k shards for k >= nprocs behind: the manifest about to be
+        # written only names proc_0..nprocs-1, so the loader would
+        # silently never read them — and a later job sized back up could
+        # mistake the stale shard for current data. Remove them (plus
+        # their torn .tmp leftovers) before the manifest makes the save
+        # real.
+        from ..resilience.retention import remove_stale_shards
+
+        remove_stale_shards(path, jax.process_count())
         manifest = {
             "format": "singa-tpu-sharded-v1",
             "step": int(step),

@@ -38,6 +38,17 @@ from ..parallel import (
     state_shardings,
 )
 from ..params import init_params
+from ..resilience.guard import (
+    GUARD_BAD,
+    GUARD_CONSEC,
+    GUARD_KEYS,
+    GUARD_LR,
+    GuardSpec,
+    apply_verdict,
+    grad_norm_sq,
+    init_guard_buffers,
+    step_guard_buffers,
+)
 from ..utils import Performance, Timers, dump_net_json
 from .checkpoint import (
     load_stream_positions,
@@ -100,6 +111,20 @@ class Trainer:
         if model_cfg.updater is None:
             raise ConfigError("model config has no updater block")
         self.updater = make_updater(model_cfg.updater)
+
+        # --- resilience seams (resilience/context.py): the supervisor
+        # (or a test) attaches a ResilienceContext; None = inert ---
+        self.resilience = None
+        self._guard = GuardSpec.from_config(model_cfg.resilience)
+        if (
+            self._guard is not None
+            and type(self)._train_step_fn is not Trainer._train_step_fn
+        ):
+            raise ConfigError(
+                f"resilience.guard_policy {self._guard.policy!r} needs the "
+                f"backprop engine's train step; {type(self).__name__} "
+                "overrides it and does not thread the guard verdict"
+            )
         root = jax.random.PRNGKey(seed)
         self._init_key, self._step_key = jax.random.split(root)
 
@@ -165,16 +190,13 @@ class Trainer:
                 )
                 for l in net.datalayers
             }
-            # resume: restore each stream to its checkpointed consumed
-            # position (completing the Worker::Resume contract — a
-            # resumed run continues the data stream, it doesn't replay
-            # from the shard start)
-            for name, pipe in self._pipelines[id(net)].items():
-                pos = getattr(self, "_resume_streams", {}).get(
-                    f"{net.phase}|{name}"
-                )
-                if pos is not None:
-                    pipe.seek(pos)
+        # resume: restore each stream to its checkpointed consumed
+        # position (completing the Worker::Resume contract — a resumed
+        # run continues the data stream, it doesn't replay from the
+        # shard start)
+        self._seek_resumed_streams()
+        #: last step boundary reached (the supervisor's progress gauge)
+        self.completed_steps = self.start_step
 
         # --- device-resident dataset fast path ---
         # When every data layer's decoded shard fits the budget, upload it
@@ -229,6 +251,11 @@ class Trainer:
         params = init_params(self._init_key, self.specs)
         state = self.updater.init_state(params)
         buffers = self.train_net.init_buffers()
+        if self._guard is not None:
+            # guard counters ride the buffer pytree (reserved dunder
+            # keys) so they thread the jitted step and checkpoint with
+            # the rest of training state for free
+            buffers.update(init_guard_buffers())
         #: stream positions waiting to be applied once pipelines exist
         self._resume_streams: dict[str, int] = {}
         if self.cfg.checkpoint and is_sharded_checkpoint(self.cfg.checkpoint):
@@ -264,6 +291,19 @@ class Trainer:
         self.buffers = {
             n: jax.device_put(v, self._repl) for n, v in buffers.items()
         }
+
+    def _seek_resumed_streams(self) -> None:
+        """Apply ``_resume_streams`` to every pipeline (used at init and
+        again after a guard rollback re-restores a checkpoint)."""
+        for net in (self.train_net, self.test_net, self.val_net):
+            if net is None:
+                continue
+            for name, pipe in self._pipelines.get(id(net), {}).items():
+                pos = getattr(self, "_resume_streams", {}).get(
+                    f"{net.phase}|{name}"
+                )
+                if pos is not None:
+                    pipe.seek(pos)
 
     # ------------------------------------------------------------------
     # pad-to-multiple storage (uneven kLayerPartition dims)
@@ -524,13 +564,44 @@ class Trainer:
             )
             return loss, (metrics, new_buffers)
 
-        (_, (metrics, new_buffers)), grads = jax.value_and_grad(
+        (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
-        params, state = self.updater.apply(
+        if self._guard is None:
+            params, state = self.updater.apply(
+                step, params, grads, state, self.specs
+            )
+            return params, state, new_buffers, metrics
+        # --- divergence guard (resilience/guard.py): one fused
+        # on-device finiteness verdict over loss + global grad-norm; a
+        # bad step's updates are dropped via where(ok, new, old) and the
+        # counters ride the buffer pytree — the verdict folds into the
+        # step's existing outputs, zero per-step host syncs ---
+        ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm_sq(grads))
+        lr_scale = buffers[GUARD_LR]
+        # rollback's LR backoff: the accumulated scale multiplies the
+        # grads inside the program (scale 1.0 is a bitwise no-op), so
+        # backing off needs no recompile and no host sync
+        grads = jax.tree.map(
+            lambda g: g * lr_scale.astype(g.dtype), grads
+        )
+        new_params, new_state = self.updater.apply(
             step, params, grads, state, self.specs
         )
-        return params, state, new_buffers, metrics
+        params = apply_verdict(ok, new_params, params)
+        state = apply_verdict(ok, new_state, state)
+        layer_new = {
+            k: v for k, v in new_buffers.items() if k not in GUARD_KEYS
+        }
+        layer_old = {k: buffers[k] for k in layer_new}
+        out_buffers = dict(apply_verdict(ok, layer_new, layer_old))
+        out_buffers.update(step_guard_buffers(ok, buffers))
+        # a skipped step's metrics would otherwise pollute the display
+        # window's running sums with NaN; report zeros for it instead
+        metrics = jax.tree.map(
+            lambda m: jnp.where(ok, m, jnp.zeros_like(m)), metrics
+        )
+        return params, state, out_buffers, metrics
 
     def _eval_batch_metrics(self, net: Net, params, buffers, batch) -> dict:
         """One eval batch -> {losslayer: metrics}. The single overridable
@@ -585,6 +656,9 @@ class Trainer:
         """TrainOneBatch (worker.cc:304-316): one forward+backward+update."""
         with self.timers.phase("data"):
             batch = self._next_batch(self.train_net)
+        if self.resilience is not None:
+            # nanloss@step fault seam (resilience/faults.py)
+            batch = self.resilience.inject_batch_faults(self, step, batch)
         self._last_batch = batch  # debug dumps reuse it (no stream skew)
         rng = jax.random.fold_in(self._step_key, step)
         with self.timers.phase("train"):
@@ -605,6 +679,9 @@ class Trainer:
         dataset on device (batch = index math inside the program) and no
         per-step host work (debug dumps want _last_batch)."""
         if not self._cached or self.cfg.debug:
+            return False
+        if self.resilience is not None and self.resilience.per_step:
+            # a pending fault plan needs exact per-step boundaries
             return False
         return self._chunk_cap() > 1
 
@@ -743,6 +820,11 @@ class Trainer:
             step + 1, cfg.checkpoint_frequency, cfg.checkpoint_after_steps
         )
         n = min(n, fire - step)
+        if self._guard is not None and self._guard.policy == "kRollback":
+            # the rollback policy reads the consecutive-bad counter at
+            # chunk boundaries; cap the chunk so detection lag stays
+            # within one rollback window
+            n = min(n, self._guard.rollback_after)
         return max(1, int(n))
 
     def _eval_params(self):
@@ -887,8 +969,14 @@ class Trainer:
                 if net is not None:
                     dump_net_json(net, vis)
         chunking = self._can_chunk()
+        ctx = self.resilience
         step = self.start_step
+        self.completed_steps = step
         while step < self.cfg.train_steps:
+            if ctx is not None:
+                # step-boundary seam: watchdog heartbeat, fault
+                # injection, preemption drain (may raise)
+                ctx.before_step(self, step)
             n = self._chunk_len(step) if chunking else 1
             self._pre_events(step)
             if n > 1:
@@ -897,6 +985,10 @@ class Trainer:
                 self.train_one_batch(step)
             self._post_events(step + n - 1)
             step += n
+            if ctx is not None:
+                # guard rollback may rewind to the last checkpoint
+                step = ctx.after_step(self, step)
+            self.completed_steps = step
         if self._checkpoint_dir() is not None:
             self.save(self.cfg.train_steps)
 
@@ -969,7 +1061,53 @@ class Trainer:
                 streams=self._stream_positions(),
             )
         self.log(f"step {step}: checkpoint -> {path}")
+        if self.resilience is not None:
+            # corrupt_ckpt fault, completeness validation, LATEST
+            # marking, keep-last-N retention (resilience/retention.py)
+            self.resilience.checkpoint_written(self, path, step)
         return path
+
+    # ------------------------------------------------------------------
+    # resilience: rollback + guard state (resilience/context.py calls)
+    # ------------------------------------------------------------------
+
+    def rollback_to(self, path: str) -> int:
+        """Mid-run restore of params/state/buffers/stream-positions from
+        checkpoint ``path`` (the divergence guard's rollback). Returns
+        the checkpoint's step — where the cadence loop continues."""
+        self.cfg.checkpoint = path
+        # take the checkpoint's own step: the pre-rollback resume step
+        # is ahead of where training is being rewound to
+        self.start_step = 0
+        self._materialize_params()
+        self._seek_resumed_streams()
+        self.completed_steps = self.start_step
+        return self.start_step
+
+    def set_guard_state(
+        self, consec: int | None = None, lr_scale: float | None = None
+    ) -> None:
+        """Host-side overwrite of the guard counters (rollback resets
+        the consecutive count and compounds the LR backoff)."""
+        if consec is not None:
+            self.buffers[GUARD_CONSEC] = jax.device_put(
+                jnp.int32(consec), self._repl
+            )
+        if lr_scale is not None:
+            self.buffers[GUARD_LR] = jax.device_put(
+                jnp.float32(lr_scale), self._repl
+            )
+
+    def guard_counters(self) -> dict[str, float]:
+        """Pull the guard counters to host — ONE device sync, so call at
+        cadence boundaries (display, end of run), never per step."""
+        if self._guard is None:
+            return {}
+        return {
+            "consecutive_bad": int(self.buffers[GUARD_CONSEC]),
+            "bad_steps": int(self.buffers[GUARD_BAD]),
+            "lr_scale": float(self.buffers[GUARD_LR]),
+        }
 
     def debug_string(self, step: int) -> str:
         """Per-layer mean-|activation| + per-param mean-|value| lines, the

@@ -195,3 +195,25 @@ def test_assemble_matches_device_values(tmp_path, data):
             np.testing.assert_array_equal(
                 ck.assemble(f"p|{n}"), np.asarray(t.params[n]), err_msg=n
             )
+
+
+def test_resave_removes_stale_shards_from_larger_job(tmp_path, data):
+    """A re-save into a dir written by a larger job removes proc_k files
+    for k >= nprocs before writing the manifest — otherwise the loader
+    would silently never read them (and a later re-sized job could
+    mistake them for current data)."""
+    t = _trainer(tmp_path, data, "a", 2, build_mesh(2, 4))
+    path = str(tmp_path / "ck.ckpt")
+    save_sharded(path, 0, t.params, t.state, t.buffers)
+    # fake leftovers from an 8-process job + a torn tmp
+    stale = ["proc_3.npz", "proc_7.npz", "proc_7.npz.tmp"]
+    for name in stale:
+        with open(os.path.join(path, name), "wb") as f:
+            f.write(b"stale")
+    save_sharded(path, 1, t.params, t.state, t.buffers)
+    names = set(os.listdir(path))
+    assert not names.intersection(stale)
+    # this single-process job's own shard + manifest survive
+    assert {"manifest.json", "proc_0.npz"} <= names
+    with ShardedCheckpoint(path) as ck:
+        assert ck.step == 1
