@@ -22,9 +22,13 @@ density), mfu divides achieved FLOP/s by the chip's bf16 peak
 the per-phase host timers — TimerInfo parity with the reference
 (include/worker/worker.h:91-114).
 
-Prints ONE JSON line. The top-level {metric, value, unit, vs_baseline}
-keeps the driver contract and carries the headline MNIST MLP number;
-"workloads" holds the full array.
+Output contract: the lossless JSON object prints first (and lands in
+BENCH.json), and the LAST stdout line is a compact machine-parseable
+summary — {metric, value, unit, vs_baseline, workloads:
+[{name, value, unit, mfu}], warm_start_saved_ms} — sized to survive
+the driver's tail capture. "compile_warm_start" in the lossless object
+reports the persistent-compilation-cache delta (cold vs warm first
+step; utils/compile_cache.py).
 
 Timing methodology (round 3): a dispatch + value-materialization round
 trip through the tunneled device costs ~115 ms REGARDLESS of the
@@ -122,16 +126,19 @@ def _bench_trainer(trainer, n1: int, n2: int, trials: int = 2):
 
 
 def _workload_result(name, trainer, slope, overhead, timed_steps,
-                     unit="samples/sec", tokens_per_sample=None):
+                     unit="samples/sec", tokens_per_sample=None,
+                     flops=None):
     from singa_tpu.utils.flops import device_peak_flops, train_step_flops
 
     # records per step: the replica trainer consumes one batch per
     # replica, so use the trainer's own accounting, not net.batchsize
     batch = trainer._batch_size
     sps = batch / slope
-    flops = train_step_flops(trainer.train_net) * getattr(
-        trainer, "_batches_per_step", 1
-    )
+    # `flops` overrides the backprop 3x-forward convention (the CD
+    # engine has no backward pass — utils/flops.py cd_step_flops)
+    if flops is None:
+        flops = train_step_flops(trainer.train_net)
+    flops *= getattr(trainer, "_batches_per_step", 1)
     peak = device_peak_flops()
     mfu = (flops / slope) / peak if peak else None
     value = sps * tokens_per_sample if tokens_per_sample else sps
@@ -216,11 +223,11 @@ def bench_cifar_alexnet(n1=256, n2=1280, batch=256):
 
 
 def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
-                 name="tinylm"):
+                 name="tinylm", conf="tinylm.conf"):
     from singa_tpu.config import load_model_config
     from singa_tpu.data.loader import synthetic_token_arrays, write_records
 
-    cfg = load_model_config(os.path.join(REPO, "examples", "lm", "tinylm.conf"))
+    cfg = load_model_config(os.path.join(REPO, "examples", "lm", conf))
     tmp = _tmpdir()
     shard = os.path.join(tmp, "shard")
     write_records(
@@ -294,6 +301,59 @@ def bench_lm_32k(n1=16, n2=48):
     )
 
 
+def bench_lm_longctx_d128(n1=64, n2=256):
+    """lm_longctx on the d_head=128 shape (tinylm_d128.conf): the flash
+    kernels are MXU-shape-bound at d=64, so doubling the head dim
+    doubles long-context MFU (r5 measured 24.2% -> 42.6% at S=8192).
+    A standing row so the repo's best long-context number is
+    regression-guarded, not BASELINE prose."""
+    return bench_tinylm(
+        n1, n2, seq_len=8192, batch=1, n_samples=32,
+        name="lm_longctx_d128", conf="tinylm_d128.conf",
+    )
+
+
+def bench_lm_32k_d128(n1=16, n2=48):
+    """lm_32k on the d_head=128 shape (r5 measured 21.6% -> 41.3%)."""
+    return bench_tinylm(
+        n1, n2, seq_len=32768, batch=1, n_samples=8,
+        name="lm_32k_d128", conf="tinylm_d128.conf",
+    )
+
+
+def bench_rbm(n1=128, n2=640, batch=100):
+    """The CD engine (BASELINE config 4) on examples/mnist/rbm.conf:
+    greedy layerwise CD-1 over the 784-1000-500-250-30 stack, one jitted
+    step for the whole stack. MFU uses the CD-specific FLOPs walk
+    (utils/flops.py cd_step_flops — CD has no backward pass, so the
+    backprop 3x-forward convention would overstate the model FLOPs).
+    Runs fp32 (the CD step does not thread compute_dtype), so on-chip
+    MFU vs the bf16 peak is conservative."""
+    from singa_tpu.config import load_model_config
+    from singa_tpu.data.loader import synthetic_arrays, write_records
+    from singa_tpu.trainer import CDTrainer
+    from singa_tpu.utils.flops import cd_step_flops
+
+    cfg = load_model_config(
+        os.path.join(REPO, "examples", "mnist", "rbm.conf")
+    )
+    tmp = _tmpdir()
+    shard = os.path.join(tmp, "shard")
+    write_records(shard, *synthetic_arrays(512, seed=0))
+    for layer in cfg.neuralnet.layer:
+        if layer.type == "kShardData":
+            layer.data_param.path = shard
+            layer.data_param.batchsize = batch
+            layer.data_param.random_skip = 0
+    _prep_cfg(cfg, 4 * (n1 + n2))
+    trainer = CDTrainer(cfg, seed=0, log=lambda s: None, prefetch=False)
+    slope, ovh, ts = _bench_trainer(trainer, n1, n2)
+    return _workload_result(
+        "rbm", trainer, slope, ovh, ts,
+        flops=cd_step_flops(trainer.train_net),
+    )
+
+
 def bench_mnist_mlp_replica(n1=256, n2=1280):
     """The async-protocol engine (ReplicaTrainer, Elastic) on the same
     flagship MLP: on one chip this runs a single replica with a protocol
@@ -323,10 +383,62 @@ BENCHES = (
     ("tinylm", bench_tinylm),
     ("lm_longctx", bench_lm_longctx),
     ("lm_32k", bench_lm_32k),
+    ("lm_longctx_d128", bench_lm_longctx_d128),
+    ("lm_32k_d128", bench_lm_32k_d128),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),
+    ("rbm", bench_rbm),
 )
+
+
+def bench_warm_start():
+    """Measure the persistent-compile-cache warm start: cold vs warm
+    first step of the flagship MLP program (utils/compile_cache.py).
+
+    Cold compiles into a fresh cache dir; ``jax.clear_caches()`` then
+    drops the in-memory executable, so the second first-step's compile
+    is served from the persistent cache — the delta is the fixed
+    per-run overhead a repeat run skips (BENCH_r05 measured 60-135 ms
+    of it). Runs LAST so the cache config cannot perturb the workload
+    rows."""
+    import jax
+
+    from __graft_entry__ import _flagship_cfg
+    from singa_tpu.trainer import Trainer
+    from singa_tpu.utils.compile_cache import enable_compile_cache
+
+    cache = tempfile.mkdtemp(prefix="singa_tpu_ccache_")
+    if not enable_compile_cache(cache, log=lambda s: None):
+        return {"error": "persistent cache unsupported by this jax"}
+
+    def first_step_ms() -> float:
+        cfg = _prep_cfg(
+            _flagship_cfg(batchsize=128, hidden_scale=0.25), 8, bf16=True
+        )
+        trainer = Trainer(
+            cfg, seed=0, log=lambda s: None, prefetch=False,
+            device_cache=False,
+        )
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        trainer.train_one_batch(0)
+        float(jnp.sum(jnp.abs(next(iter(trainer.params.values())))))
+        return (time.perf_counter() - t0) * 1e3
+
+    cold = first_step_ms()
+    jax.clear_caches()  # drop in-memory executables; disk cache remains
+    warm = first_step_ms()
+    return {
+        "cold_first_step_ms": round(cold, 1),
+        "warm_first_step_ms": round(warm, 1),
+        "saved_ms": round(cold - warm, 1),
+        "method": (
+            "flagship-MLP first step, fresh cache dir vs persistent-cache "
+            "hit after jax.clear_caches()"
+        ),
+    }
 
 
 #: set by main(): a partial (workload-selected) run writes its JSON to
@@ -339,10 +451,11 @@ def main() -> int:
     global _PARTIAL_RUN
     only = set(sys.argv[1:])
     _PARTIAL_RUN = bool(only)
-    unknown = only - {name for name, _ in BENCHES}
+    unknown = only - {name for name, _ in BENCHES} - {"warm_start"}
     if unknown:
         print(f"unknown workload(s): {sorted(unknown)}; "
-              f"choose from {[n for n, _ in BENCHES]}", file=sys.stderr)
+              f"choose from {[n for n, _ in BENCHES] + ['warm_start']}",
+              file=sys.stderr)
         return 2
     workloads = []
     for name, fn in BENCHES:
@@ -358,6 +471,33 @@ def main() -> int:
         (w for w in workloads if w.get("name") == "mnist_mlp" and "value" in w),
         None,
     )
+    # persistent-compile warm start: measured after every workload (it
+    # flips global cache config). The probe's same-process cache re-read
+    # pattern can in principle hard-crash jaxlib (the reason
+    # utils/compile_cache.py disables the cache for supervisor
+    # restarts), and a segfault is not catchable — so the measured
+    # workloads are persisted to the BENCH file FIRST, in the full
+    # contract shape; a probe crash costs the warm-start number, never
+    # the suite.
+    warm_start = None
+    if not only or "warm_start" in only:
+        _write_bench_file(json.dumps({
+            "metric": "mnist_mlp_train_throughput",
+            "value": head["value"] if head else None,
+            "unit": "samples/sec",
+            "vs_baseline": (
+                round(head["value"] / BASELINE_SPS, 3) if head else None
+            ),
+            "baseline_note": BASELINE_NOTE,
+            "compile_warm_start": None,
+            "workloads": workloads,
+        }))
+        try:
+            warm_start = bench_warm_start()
+        except Exception:
+            print("bench warm_start FAILED:", file=sys.stderr)
+            traceback.print_exc()
+            warm_start = {"error": "failed (see stderr)"}
     if head is None and only and "mnist_mlp" not in only:
         # headline workload deliberately not selected: promote the first
         # measured workload instead of reporting a misreadable 0.0
@@ -371,10 +511,17 @@ def main() -> int:
             "unit": promoted["unit"] if promoted else "samples/sec",
             "vs_baseline": None,  # baseline is the MNIST MLP number
             "baseline_note": BASELINE_NOTE,
+            "compile_warm_start": warm_start,
             "workloads": workloads,
         }
         _emit(out)
-        return 0 if promoted else 1
+        # same policy as the full suite (where only a missing HEADLINE
+        # fails the run): a selection fails only when NO selected
+        # workload produced a value — except a warm_start-ONLY run,
+        # which gates on the warm-start measurement itself
+        warm_ok = warm_start is not None and "error" not in warm_start
+        only_warm = not (only - {"warm_start"})
+        return 0 if (promoted or (warm_ok and only_warm)) else 1
     out = {
         "metric": "mnist_mlp_train_throughput",
         "value": head["value"] if head else None,
@@ -383,6 +530,7 @@ def main() -> int:
             round(head["value"] / BASELINE_SPS, 3) if head else None
         ),
         "baseline_note": BASELINE_NOTE,
+        "compile_warm_start": warm_start,
         "workloads": workloads,
     }
     _emit(out)
@@ -393,13 +541,7 @@ def main() -> int:
     return 0
 
 
-def _emit(out: dict) -> None:
-    """Print the one-line contract AND write it to a file: the driver's
-    `parsed` field tail-captures stdout, which a 4 KB JSON line can
-    defeat — BENCH.json is the lossless copy (SINGA_TPU_BENCH_OUT to
-    relocate)."""
-    line = json.dumps(out)
-    print(line)
+def _write_bench_file(line: str) -> None:
     default = os.path.join(
         REPO, "BENCH.partial.json" if _PARTIAL_RUN else "BENCH.json"
     )
@@ -409,6 +551,44 @@ def _emit(out: dict) -> None:
             f.write(line + "\n")
     except OSError as e:
         print(f"bench: could not write {path}: {e}", file=sys.stderr)
+
+
+def _emit(out: dict) -> None:
+    """Write the lossless record, then end stdout with ONE compact
+    machine-parseable JSON line.
+
+    The driver's `parsed` field tail-captures stdout, which the ~5 KB
+    lossless line defeats (BENCH_r04/r05 `parsed: null`) — so the
+    lossless object goes to BENCH.json (SINGA_TPU_BENCH_OUT to
+    relocate) and is printed first for humans, and the LAST stdout line
+    is a compact summary (headline + per-workload name/value/mfu +
+    warm-start delta) sized to survive tail capture."""
+    line = json.dumps(out)
+    print(line)
+    _write_bench_file(line)
+    compact = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "workloads": [
+            (
+                {"name": w["name"], "error": w["error"]}
+                if "error" in w
+                else {
+                    "name": w["name"],
+                    "value": w.get("value"),
+                    "unit": w.get("unit"),
+                    "mfu": w.get("mfu"),
+                }
+            )
+            for w in out.get("workloads", [])
+        ],
+    }
+    ws = out.get("compile_warm_start")
+    if ws is not None:
+        compact["warm_start_saved_ms"] = ws.get("saved_ms")
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

@@ -618,6 +618,13 @@ class ResilienceConfig(Message):
         "backoff_max": Field("float", 60.0),
         # --- retention: keep-last-N complete checkpoints + LATEST ---
         "keep_last": Field("int", 3),
+        # --- zero-stall checkpointing (resilience/async_ckpt.py): the
+        # save becomes a non-blocking device snapshot at the step
+        # boundary + a background writer thread (double-buffered; a full
+        # buffer applies backpressure). SIGTERM drain flushes the
+        # in-flight write before exiting resumable; a crash mid-write
+        # never corrupts LATEST. false = the synchronous save path. ---
+        "async_checkpoint": Field("bool", False),
         # --- divergence guard (on-device; no per-step host sync) ---
         # kSkip: drop a non-finite step's update and count it;
         # kRollback: additionally restore the last checkpoint with an LR
@@ -704,6 +711,13 @@ class ClusterConfig(Message):
         "nseq_per_group": Field("int", 1),
         "nexperts_per_group": Field("int", 1),
         "npipes_per_group": Field("int", 1),
+        # ---- singa-tpu extension: persistent XLA compilation cache.
+        # main.py wires jax's compile cache to this directory so repeat
+        # runs skip recompilation (BENCH_r05 measured 60-135 ms of fixed
+        # per-run startup, mostly XLA compiles). "" = default
+        # <workspace>/compile_cache; "off" disables; the
+        # SINGA_TPU_COMPILE_CACHE env var overrides either.
+        "compile_cache_dir": Field("string", ""),
     }
 
     @property

@@ -63,6 +63,12 @@ def main(argv: list[str] | None = None) -> int:
     cluster_cfg = (
         load_cluster_config(args.cluster_conf) if args.cluster_conf else None
     )
+    # persistent-compile warm start: repeat runs skip XLA recompilation
+    # (cache dir from the cluster conf / workspace; SINGA_TPU_COMPILE_CACHE
+    # overrides, "off" disables — utils/compile_cache.py)
+    from .utils.compile_cache import setup_compile_cache
+
+    setup_compile_cache(cluster_cfg)
     # every job routes through the supervisor: configs without a
     # resilience block (and no fault plan) take its transparent
     # single-attempt path; configs with one get auto-resume, preemption

@@ -16,6 +16,10 @@ obligation moves into this trainer-side resilience layer:
   guard.py        on-device loss/grad-norm finiteness verdict with
                   skip / rollback-with-LR-backoff policies — zero
                   per-step host syncs
+  async_ckpt.py   zero-stall checkpointing — non-blocking device
+                  snapshot at the step boundary + a double-buffered
+                  background writer publishing through retention's
+                  atomic LATEST (``async_checkpoint: true``)
   watchdog.py     step-wall-clock watchdog (hung-collective detection)
   faults.py       the deterministic fault plan (``crash@7,...``) that
                   lets tests PROVE end-to-end recovery
@@ -29,12 +33,14 @@ supervisor. ``supervisor`` itself is imported lazily (it pulls in the
 trainer package) — use ``from singa_tpu.resilience import supervisor``.
 """
 
+from .async_ckpt import AsyncCheckpointer, AsyncWriteError  # noqa: F401
 from .context import ResilienceContext  # noqa: F401
 from .faults import (  # noqa: F401
     FaultPlan,
     FaultPlanError,
     FaultSpec,
     InjectedCrash,
+    tear_file,
 )
 from .guard import (  # noqa: F401
     GUARD_BAD,

@@ -1,0 +1,201 @@
+"""Zero-stall checkpointing: a two-stage snapshot -> write pipeline.
+
+The synchronous save path (trainer.save -> np.savez) drains the device,
+funnels every param through host memory, and blocks the step loop until
+the file lands on disk — with ``checkpoint_frequency: 1`` the write IS
+the step time. This module takes checkpoint I/O off the step path the
+way TensorFlow treats it as background dataflow decoupled from the
+training step (PAPERS.md) and Parameter Box keeps parameter movement
+off the critical path:
+
+  stage 1 — snapshot (main thread, at the step boundary): the trainer
+      runs ONE jitted identity-copy program over params/state/buffers
+      (no donation — the live arrays stay valid for the next, donating,
+      train step), kicks ``copy_to_host_async()`` on every leaf so the
+      device->host DMA overlaps the next steps, and submits the copies
+      here. The step loop never waits on disk.
+
+  stage 2 — write (the one writer thread): materialize the host
+      snapshot (``np.asarray`` joins the already-running async copies),
+      serialize through the existing torn-write discipline (tmp file +
+      atomic rename from trainer/checkpoint.py, CRC validation + atomic
+      ``LATEST`` from resilience/retention.py via the context's
+      ``checkpoint_written`` seam), then pick up the next snapshot.
+
+Memory discipline: snapshots are DOUBLE-buffered. The queue holds at
+most one pending snapshot while one write is in flight; a third
+``submit`` blocks until the writer frees a slot — backpressure, never
+unbounded growth. A job whose write cadence outruns its disk degrades
+to the old synchronous stall instead of OOMing.
+
+Ordering and crash safety:
+
+  - one FIFO queue + one writer thread => checkpoints PUBLISH (reach
+    ``LATEST``) in step order, always.
+  - the writer marks ``LATEST`` only after the file validates (the
+    ``checkpoint_written`` callback), so a crash mid-write — proven by
+    the ``async_torn_write@K`` injected fault, which tears the K-th
+    async write and kills its publication step — leaves ``LATEST`` on
+    the previous complete save. Resume falls back exactly as for a
+    synchronous torn save.
+  - ``flush()`` blocks until everything submitted is on disk: the
+    preemption drain calls it before exiting 75 (the final checkpoint
+    must be durable before the launcher relaunches), the supervisor
+    calls it before resolving ``LATEST`` for a restart, and the guard
+    calls it before a rollback restore.
+
+A write failure (disk full, permission) is logged loudly, remembered,
+and re-raised by the next ``flush()``/``submit()`` — the step loop
+learns about it at the next checkpoint boundary instead of training on
+with silently-unsaved state.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from .faults import FaultPlan, tear_file
+
+
+class AsyncWriteError(RuntimeError):
+    """A background checkpoint write failed; raised at the next
+    submit/flush so the step loop cannot silently outrun a dead disk."""
+
+
+#: queue slots for snapshots awaiting the writer: 1 pending + 1 in
+#: flight = the double buffer. submit() blocks when both are taken.
+_PENDING_SLOTS = 1
+
+
+class AsyncCheckpointer:
+    """The stage-2 writer: one thread, FIFO, double-buffered."""
+
+    def __init__(self, plan: FaultPlan | None = None, log=print):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.log = log
+        self._q: queue.Queue = queue.Queue(maxsize=_PENDING_SLOTS)
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._error: BaseException | None = None
+        #: 1-based count of async writes reaching the writer
+        #: (``async_torn_write@K`` keys on it, like corrupt_ckpt's save
+        #: ordinal — and like it, shared across restart attempts)
+        self.write_ordinal = 0
+        self.submitted = 0
+        #: writes fully published (file on disk + checkpoint_written ran)
+        self.published = 0
+        #: torn or failed writes (consumed from the queue, unpublished)
+        self._consumed_abnormal = 0
+        #: high-water mark of snapshots alive at once (tests pin the
+        #: double-buffer bound with it)
+        self.max_in_flight = 0
+
+    # ------------------------------------------------------------------
+    # main-thread API
+    # ------------------------------------------------------------------
+
+    def submit(self, step: int, path: str, write_fn, on_written=None) -> None:
+        """Queue one snapshot for background serialization.
+
+        ``write_fn()`` must serialize the snapshot to ``path`` with the
+        tmp+rename discipline; ``on_written(path, step)`` runs after a
+        successful write (validation/LATEST/retention — the context's
+        ``checkpoint_written`` seam). Blocks while the double buffer is
+        full (backpressure). Raises AsyncWriteError if a previous write
+        failed."""
+        self._raise_pending()
+        self._ensure_thread()
+        self._q.put((step, path, write_fn, on_written))
+        self.submitted += 1
+        self.max_in_flight = max(self.max_in_flight, self.in_flight())
+
+    def in_flight(self) -> int:
+        """Snapshots submitted but not yet written (or torn/failed)."""
+        return self.submitted - self.published - self._consumed_abnormal
+
+    def flush(self, raise_errors: bool = True) -> None:
+        """Block until every submitted snapshot is fully written and
+        published. The SIGTERM drain's durability barrier.
+
+        ``raise_errors=False`` (the restart/teardown paths) CONSUMES any
+        pending write error instead of re-raising it: the writer already
+        logged it loudly, and a stale failure from a crashed attempt
+        must not resurface as a spurious "death" of a later, healthy
+        attempt."""
+        if self._thread is not None:
+            self._q.join()
+        if raise_errors:
+            self._raise_pending()
+        else:
+            with self._lock:
+                self._error = None
+
+    def stop(self) -> None:
+        """Flush (swallowing errors — stop runs in ``finally`` paths)
+        and shut the writer thread down."""
+        t = self._thread
+        if t is None:
+            return
+        self.flush(raise_errors=False)
+        self._q.put(None)
+        t.join()
+        self._thread = None
+
+    # ------------------------------------------------------------------
+    # writer thread
+    # ------------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="async-ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise AsyncWriteError(
+                f"background checkpoint write failed: "
+                f"{type(err).__name__}: {err}"
+            ) from err
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            step, path, write_fn, on_written = item
+            try:
+                write_fn()
+                self.write_ordinal += 1
+                spec = self.plan.fire("async_torn_write", self.write_ordinal)
+                if spec is not None:
+                    # simulate the writer dying mid-publish: the file is
+                    # torn and checkpoint_written (validation + LATEST)
+                    # never runs — LATEST must keep naming the previous
+                    # complete save
+                    tear_file(path)
+                    self._consumed_abnormal += 1
+                    self.log(
+                        f"FAULT: async_torn_write@{self.write_ordinal} — "
+                        f"writer died mid-publish of {path} (torn file "
+                        "left behind, LATEST untouched)"
+                    )
+                else:
+                    if on_written is not None:
+                        on_written(path, step)
+                    self.published += 1
+            except BaseException as e:  # surface on the main thread
+                with self._lock:
+                    self._error = e
+                self._consumed_abnormal += 1
+                self.log(
+                    f"ERROR: async checkpoint write of {path} failed — "
+                    f"{type(e).__name__}: {e}"
+                )
+            finally:
+                self._q.task_done()

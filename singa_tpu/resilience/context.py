@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from ..config.schema import ResilienceConfig
 from . import retention
-from .faults import FaultPlan, InjectedCrash
+from .async_ckpt import AsyncCheckpointer
+from .faults import FaultPlan, InjectedCrash, tear_file
 from .guard import GUARD_CONSEC, GUARD_LR, GuardGaveUp
 from .preemption import PreemptionDrained, PreemptionHandler
 from .watchdog import Watchdog
@@ -46,6 +47,16 @@ class ResilienceContext:
         self.log = log
         self.preemption = PreemptionHandler()
         self.watchdog = Watchdog(self.cfg.watchdog_timeout, log)
+        #: zero-stall checkpoint pipeline (resilience/async_ckpt.py);
+        #: None = the synchronous save path. ONE writer across restart
+        #: attempts, like the fault plan — ordinals stay coherent.
+        self.async_ckpt = (
+            AsyncCheckpointer(plan=self.plan, log=log)
+            if self.cfg.async_checkpoint
+            else None
+        )
+        #: guard rollbacks performed (surfaced in the display line)
+        self.rollbacks = 0
         #: <workspace>/checkpoints, once a trainer with a workspace binds
         self.ckpt_dir: str | None = None
         #: 1-based ordinal of checkpoint saves (corrupt_ckpt@K keys on it)
@@ -76,6 +87,15 @@ class ResilienceContext:
 
     def stop(self) -> None:
         self.watchdog.stop()
+        if self.async_ckpt is not None:
+            self.async_ckpt.stop()
+
+    def flush_async(self, raise_errors: bool = True) -> None:
+        """Durability barrier: block until every submitted async
+        checkpoint write is on disk and published. No-op when the
+        synchronous path is in use."""
+        if self.async_ckpt is not None:
+            self.async_ckpt.flush(raise_errors=raise_errors)
 
     # ------------------------------------------------------------------
     # step-boundary seams
@@ -106,6 +126,9 @@ class ResilienceContext:
         path = None
         if self.cfg.preemption_checkpoint:
             path = trainer.save(step)
+            # the final checkpoint must be DURABLE before exit 75 — the
+            # launcher may relaunch the moment the process dies
+            self.flush_async()
         where = (
             f", final checkpoint {path}"
             if path
@@ -156,6 +179,9 @@ class ResilienceContext:
                 "replays deterministically; refusing to livelock"
             )
         new_scale = float(trainer.buffers[GUARD_LR]) * g.lr_backoff
+        # land any in-flight async write first: the rollback should
+        # restore the NEWEST complete checkpoint, not race its publish
+        self.flush_async(raise_errors=False)
         path = retention.resolve_latest(self.ckpt_dir)
         if path is None:
             self.log(
@@ -170,6 +196,7 @@ class ResilienceContext:
             f"rolling back to {path}, LR scale -> {new_scale:g}"
         )
         rolled = trainer.rollback_to(path)
+        self.rollbacks += 1
         trainer.set_guard_state(consec=0, lr_scale=new_scale)
         # re-arm the window from the rollback point so the next check
         # happens a full window after training resumes
@@ -207,7 +234,7 @@ class ResilienceContext:
         self.save_ordinal += 1
         spec = self.plan.fire("corrupt_ckpt", self.save_ordinal)
         if spec is not None:
-            self._corrupt(path)
+            tear_file(path)
             self.log(
                 f"FAULT: corrupt_ckpt@{self.save_ordinal} — tore {path}"
             )
@@ -256,16 +283,3 @@ class ResilienceContext:
                 return
             time.sleep(0.05)
 
-    @staticmethod
-    def _corrupt(path: str) -> None:
-        """Simulate a torn write: truncate the save to half its bytes
-        (the shard file, for sharded dirs)."""
-        target = path
-        if os.path.isdir(path):
-            target = os.path.join(path, "proc_0.npz")
-        try:
-            size = os.path.getsize(target)
-            with open(target, "r+b") as f:
-                f.truncate(max(1, size // 2))
-        except OSError:
-            pass

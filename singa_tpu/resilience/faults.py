@@ -15,6 +15,12 @@ A fault plan is a comma-separated list of ``kind@at`` terms (optionally
                    torn-save detection in the retention module
   slowstep@9=0.5   sleep 0.5 s at the step-9 boundary — exercises the
                    step-wall-clock watchdog
+  async_torn_write@1  tear the 1st ASYNC checkpoint write (ordinal,
+                   1-based) and kill its publication — the writer
+                   "dies" mid-write, before validation/LATEST ever run.
+                   Exercises the zero-stall pipeline's crash safety
+                   (resilience/async_ckpt.py): LATEST must keep naming
+                   the previous complete save
 
 Every fault fires exactly once per plan object. The supervisor owns ONE
 plan across all restart attempts, so ``crash@7`` does not re-fire after
@@ -28,6 +34,7 @@ device programs are bit-identical to a clean run's.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 
 class FaultPlanError(ValueError):
@@ -38,10 +45,33 @@ class InjectedCrash(RuntimeError):
     """The failure a ``crash@N`` fault raises at its step boundary."""
 
 
-KINDS = ("crash", "sigterm", "nanloss", "corrupt_ckpt", "slowstep")
+KINDS = (
+    "crash",
+    "sigterm",
+    "nanloss",
+    "corrupt_ckpt",
+    "slowstep",
+    "async_torn_write",
+)
 
 #: kinds triggered by step number at the pre-step boundary seam
 STEP_KINDS = ("crash", "sigterm", "slowstep")
+
+
+def tear_file(path: str) -> None:
+    """Simulate a torn write: truncate ``path`` to half its bytes (the
+    proc_0 shard, for sharded checkpoint dirs). The ONE copy of the
+    tearing logic — both the corrupt_ckpt fault (context.py) and the
+    async_torn_write fault (async_ckpt.py) use it."""
+    target = path
+    if os.path.isdir(path):
+        target = os.path.join(path, "proc_0.npz")
+    try:
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    except OSError:
+        pass
 
 
 @dataclasses.dataclass

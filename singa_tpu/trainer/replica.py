@@ -105,6 +105,8 @@ class ReplicaTrainer(Trainer):
         self._warmup_timed = 0
         self._sync_rng = np.random.RandomState(seed ^ 0x5EED)
         self._sync_jit: Callable | None = None
+        #: fused unpad+copy program for the async .server sidecar
+        self._sidecar_snap_fn: Callable | None = None
         #: (nwindows, window_len) -> jitted multi-window program
         self._fused_chunk_fns: dict[tuple[int, int], Callable] = {}
         super().__init__(
@@ -523,46 +525,84 @@ class ReplicaTrainer(Trainer):
     def _eval_buffers(self):
         return {n: v[0] for n, v in self.buffers.items()}
 
-    def save(self, step: int):
-        path = super().save(step)
-        if path is not None and self.center is not None:
-            from .checkpoint import save_checkpoint
+    def _prepare_save(self, folder: str, step: int, snapshot: bool):
+        """Extend the base save with the ``.server`` sidecar (center +
+        protocol snapshot). Under the zero-stall path the sidecar trees
+        are device-COPIED here too: the protocol round's fused program
+        donates the live center/snapshot buffers, so the async writer
+        must own separate storage. Cross-process allgathers (collective)
+        always run here, on the main thread — never in the writer."""
+        path, write = super()._prepare_save(folder, step, snapshot)
+        if self.center is None:
+            return path, write
+        from .checkpoint import save_checkpoint
 
-            def host_view(v):
-                """np-ready view; replica-axis arrays SPAN processes in
-                multi-host jobs (e.g. the RandomSync snapshot on the
-                2-process topology) — allgather them collectively.
-                Every rank walks the same dict order, so the collective
-                calls line up."""
-                if (
-                    jax.process_count() > 1
-                    and not v.is_fully_addressable
-                    and not v.sharding.is_fully_replicated
-                ):
-                    from jax.experimental import multihost_utils
+        # server-side trees store LOGICAL shapes like the base npz
+        # format (resume re-pads for its mesh)
+        if snapshot:
+            # ONE compiled unpad+copy program over both trees (like the
+            # base _snapshot_trees) — per-leaf eager copies would put a
+            # dispatch round trip per param on exactly the step-boundary
+            # path the zero-stall feature keeps clear
+            if self._sidecar_snap_fn is None:
 
-                    return multihost_utils.process_allgather(v, tiled=True)
-                return v
+                def snap_fn(center, snap):
+                    return (
+                        {
+                            n: self._unpad_one(n, jnp.copy(v))
+                            for n, v in center.items()
+                        },
+                        {
+                            n: self._unpad_one(n, jnp.copy(v))
+                            for n, v in snap.items()
+                        },
+                    )
 
-            # server-side trees store LOGICAL shapes like the base npz
-            # format (resume re-pads for its mesh)
-            server = {
-                n: host_view(self._unpad_one(n, v))
-                for n, v in self.center.items()
-            }
-            server["__sample_ratio__"] = jnp.float32(self.sample_ratio)
-            snap = (
-                {
-                    "__snapshot__": {
-                        n: host_view(self._unpad_one(n, v))
-                        for n, v in self.snapshot.items()
-                    }
-                }
-                if self.snapshot
-                else None
+                # the sidecar snapshot copies the LIVE center/snapshot
+                self._sidecar_snap_fn = jax.jit(snap_fn)  # netlint: disable=JAX003
+            center_t, snap_t = self._sidecar_snap_fn(
+                self.center, self.snapshot or {}
             )
+        else:
+            center_t = {
+                n: self._unpad_one(n, v) for n, v in self.center.items()
+            }
+            snap_t = {
+                n: self._unpad_one(n, v)
+                for n, v in (self.snapshot or {}).items()
+            }
+
+        def host_view(v):
+            """np-ready view; replica-axis arrays SPAN processes in
+            multi-host jobs (e.g. the RandomSync snapshot on the
+            2-process topology) — allgather them collectively.
+            Every rank walks the same dict order, so the collective
+            calls line up."""
+            if (
+                jax.process_count() > 1
+                and not v.is_fully_addressable
+                and not v.sharding.is_fully_replicated
+            ):
+                from jax.experimental import multihost_utils
+
+                return multihost_utils.process_allgather(v, tiled=True)
+            if snapshot and hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+            return v
+
+        server = {n: host_view(v) for n, v in center_t.items()}
+        server["__sample_ratio__"] = jnp.float32(self.sample_ratio)
+        snap = (
+            {"__snapshot__": {n: host_view(v) for n, v in snap_t.items()}}
+            if snap_t
+            else None
+        )
+
+        def write_with_sidecar() -> None:
+            write()
             save_checkpoint(path + ".server", step, server, snap)
-        return path
+
+        return path, write_with_sidecar
 
     def _resume(self, path: str) -> None:
         import os

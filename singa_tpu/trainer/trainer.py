@@ -235,6 +235,8 @@ class Trainer:
         self._chunk_fns: dict[int, Callable] = {}
         self._eval_steps: dict[int, Callable] = {}
         self._eval_chunk_fns: dict[tuple[int, int], Callable] = {}
+        #: unpad? -> compiled snapshot program (zero-stall checkpointing)
+        self._snapshot_fns: dict[bool, Callable] = {}
         self._batch_size = self.train_net.batchsize
 
     # ------------------------------------------------------------------
@@ -930,9 +932,20 @@ class Trainer:
             t = self.timers.total("train") + self.timers.total("data")
             if t > 0:
                 sps = self.perf.count * self._batch_size / t
+            # divergence-guard counters ride the display line (ONE host
+            # sync, at display cadence — never per step); rollbacks are
+            # the context's count
+            guard = ""
+            if self._guard is not None:
+                g = self.guard_counters()
+                rb = getattr(self.resilience, "rollbacks", 0)
+                guard = (
+                    f" guard[bad {g['bad_steps']}, rollbacks {rb}, "
+                    f"lr x{g['lr_scale']:g}]"
+                )
             self.log(
                 f"step {step}: train {self.perf.to_string()} "
-                f"[{self.timers.to_string()}; {sps:.0f} samples/s]"
+                f"[{self.timers.to_string()}; {sps:.0f} samples/s]{guard}"
             )
             if cfg.debug:
                 self.log(self.debug_string(step))
@@ -1014,6 +1027,41 @@ class Trainer:
         folder = self._checkpoint_dir()
         if folder is None:
             return None
+        ctx = self.resilience
+        writer = ctx.async_ckpt if ctx is not None else None
+        if writer is None:
+            path, write = self._prepare_save(folder, step, snapshot=False)
+            write()
+            self.log(f"step {step}: checkpoint -> {path}")
+            if ctx is not None:
+                # corrupt_ckpt fault, completeness validation, LATEST
+                # marking, keep-last-N retention (resilience/retention.py)
+                ctx.checkpoint_written(self, path, step)
+            return path
+        # --- zero-stall path (resilience/async_ckpt.py): snapshot the
+        # state with one non-donating device-copy program, start the
+        # device->host DMA, and hand serialization to the writer thread.
+        # The step loop continues immediately; validation/LATEST/
+        # retention run from the writer via the same checkpoint_written
+        # seam, in submit (= step) order. ---
+        path, write = self._prepare_save(folder, step, snapshot=True)
+        writer.submit(
+            step, path, write,
+            on_written=lambda p, s: ctx.checkpoint_written(self, p, s),
+        )
+        self.log(f"step {step}: checkpoint (async) -> {path}")
+        return path
+
+    def _prepare_save(self, folder: str, step: int, snapshot: bool):
+        """-> (final path, zero-arg write closure) for one checkpoint.
+
+        ``snapshot=False`` captures the LIVE arrays (the synchronous
+        path — the closure runs before the next step). ``snapshot=True``
+        captures fresh device-side COPIES with their host transfers
+        already started, so the closure is safe to run from the async
+        writer thread while the (donating) train loop advances: it only
+        materializes host buffers and writes files, never dispatches new
+        device programs."""
         # a model axis spanning process boundaries (cross-process
         # kLayerPartition) leaves params PARTITIONED with shards this
         # host cannot see: the host-gathering npz writer cannot
@@ -1040,32 +1088,65 @@ class Trainer:
             )
             or _spanning(self.buffers.values())
         )
-        if self.cfg.checkpoint_format == "sharded" or spans_procs:
-            from .sharded_ckpt import save_sharded
-
-            path = os.path.join(folder, f"step_{step}.ckpt")
-            save_sharded(
-                path, step, self.params, self.state, self.buffers,
-                streams=self._stream_positions(),
-            )
+        sharded = self.cfg.checkpoint_format == "sharded" or spans_procs
+        streams = self._stream_positions()
+        if snapshot:
+            # the sharded format stores STORED (padded) shapes; npz
+            # stores LOGICAL ones, so its snapshot program unpads inside
+            # the same dispatch
+            params, state, buffers = self._snapshot_trees(unpad=not sharded)
+            for leaf in jax.tree.leaves((params, state, buffers)):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+        elif sharded:
+            params, state, buffers = self.params, self.state, self.buffers
         else:
-            path = os.path.join(folder, f"step_{step}.npz")
             # npz checkpoints are host-gathered and mesh-portable: store
             # LOGICAL shapes (a resume onto a different model-axis width
             # re-pads for its own mesh)
-            save_checkpoint(
-                path, step,
-                self._unpad_stored(self.params),
-                self._unpad_state(self.state),
-                self.buffers,
-                streams=self._stream_positions(),
-            )
-        self.log(f"step {step}: checkpoint -> {path}")
-        if self.resilience is not None:
-            # corrupt_ckpt fault, completeness validation, LATEST
-            # marking, keep-last-N retention (resilience/retention.py)
-            self.resilience.checkpoint_written(self, path, step)
-        return path
+            params = self._unpad_stored(self.params)
+            state = self._unpad_state(self.state)
+            buffers = self.buffers
+        if sharded:
+            from .sharded_ckpt import save_sharded
+
+            path = os.path.join(folder, f"step_{step}.ckpt")
+
+            def write() -> None:
+                save_sharded(
+                    path, step, params, state, buffers, streams=streams
+                )
+
+        else:
+            path = os.path.join(folder, f"step_{step}.npz")
+
+            def write() -> None:
+                save_checkpoint(
+                    path, step, params, state, buffers, streams=streams
+                )
+
+        return path, write
+
+    def _snapshot_trees(self, unpad: bool):
+        """Donation-safe device copies of (params, state, buffers) in
+        ONE compiled program (npz variant also unpads inside it). The
+        copies are fresh buffers the async writer owns outright — the
+        live training arrays stay valid for the next, donating, train
+        step, and the writer thread never has to dispatch device work."""
+        if unpad not in self._snapshot_fns:
+
+            def snap(params, state, buffers):
+                params, state, buffers = jax.tree.map(
+                    jnp.copy, (params, state, buffers)
+                )
+                if unpad:
+                    params = self._unpad_stored(params)
+                    state = self._unpad_state(state)
+                return params, state, buffers
+
+            # snapshots must NOT donate: the inputs are the live params
+            self._snapshot_fns[unpad] = jax.jit(snap)  # netlint: disable=JAX003
+        return self._snapshot_fns[unpad](self.params, self.state, self.buffers)
 
     # ------------------------------------------------------------------
     # resilience: rollback + guard state (resilience/context.py calls)

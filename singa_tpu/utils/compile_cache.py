@@ -1,0 +1,92 @@
+"""Persistent XLA compilation cache: warm-start repeat runs.
+
+BENCH_r05 measured 60-135 ms of fixed per-run startup overhead, mostly
+XLA recompilation of programs that are bit-identical across runs (the
+train step, the eval chunks, the snapshot copy). jax ships a persistent
+compilation cache keyed on the lowered computation; pointing it at a
+directory makes every run after the first skip those compiles entirely.
+
+Resolution order for the cache directory (first hit wins):
+
+  1. ``SINGA_TPU_COMPILE_CACHE`` env var — operators override per run
+     (the values ``0``/``off``/``none`` disable the cache)
+  2. ``ClusterConfig.compile_cache_dir`` — the cluster conf pins a
+     shared location (same ``off`` spellings disable)
+  3. ``<workspace>/compile_cache`` — the default for any job with a
+     workspace; jobs without one run uncached (nowhere durable to put it)
+
+``bench.py`` measures the realized warm-start delta (cold vs warm first
+step) and reports it as ``compile_warm_start`` in its output.
+"""
+
+from __future__ import annotations
+
+import os
+
+_OFF = ("", "0", "off", "none", "false")
+
+
+def resolve_cache_dir(cluster_cfg=None) -> str | None:
+    """The persistent-cache directory the resolution order picks, or
+    None when caching is disabled / unconfigured."""
+    path = os.environ.get("SINGA_TPU_COMPILE_CACHE")
+    if path is None and cluster_cfg is not None:
+        if cluster_cfg.compile_cache_dir:
+            path = cluster_cfg.compile_cache_dir
+        elif cluster_cfg.workspace:
+            path = os.path.join(cluster_cfg.workspace, "compile_cache")
+    if path is None or path.strip().lower() in _OFF:
+        return None
+    return path
+
+
+def enable_compile_cache(path: str, log=print) -> bool:
+    """Point jax's persistent compilation cache at ``path``. The
+    min-time/min-size gates are zeroed: singa-tpu jobs compile a handful
+    of large programs, so every entry is worth keeping. Returns False
+    (and keeps running uncached) on jax builds without the knobs."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - version-dependent
+        log(f"persistent compile cache unavailable ({e}); running uncached")
+        return False
+    return True
+
+
+def disable_compile_cache(log=print) -> None:
+    """Turn the persistent cache off for the rest of this process.
+
+    The supervisor calls this before an in-process restart attempt
+    rebuilds the trainer: re-jitting the same programs in the process
+    that just wrote their cache entries can crash jaxlib's executable
+    deserialization (segfault observed on the CPU backend after a
+    mid-run crash). Restarts are the rare path — losing the cache there
+    costs one recompile; the cross-process warm start (the actual win)
+    is untouched."""
+    import jax
+
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", None)
+            log(
+                "persistent compile cache: disabled for restart attempts "
+                "(in-process re-read of fresh entries is not crash-safe)"
+            )
+    except Exception:  # pragma: no cover - version-dependent
+        pass
+
+
+def setup_compile_cache(cluster_cfg=None, log=print) -> str | None:
+    """Resolve + enable in one call (main.py's entry). Returns the
+    active cache dir, or None when disabled."""
+    path = resolve_cache_dir(cluster_cfg)
+    if path is None:
+        return None
+    if not enable_compile_cache(path, log=log):
+        return None
+    log(f"persistent compile cache: {path}")
+    return path

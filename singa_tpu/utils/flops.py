@@ -69,6 +69,30 @@ def train_step_flops(net) -> float:
     return 3.0 * total
 
 
+def cd_step_flops(net) -> float:
+    """Model FLOPs of one greedy-layerwise CD-k train step (CDTrainer).
+
+    The 3x-forward backprop convention does not apply: CD has no
+    backward pass. Per RBM, one step runs the positive-phase up-prop
+    (2bvh), cd_k Gibbs iterations (down + up, 4bvh each), and the two
+    gradient outer products v0^T h0 and vk^T hk (2bvh each) — all
+    matmul-class, everything else (sigmoids, Bernoulli draws, bias
+    grads) omitted per the MFU convention above. Non-RBM layers in the
+    chain (parsers) contribute their forward cost once."""
+    total = 0.0
+    for layer in net.layers:
+        srcs = [net.name2layer[s].out_shape for s in layer.srclayers]
+        if layer.TYPE != "kRBM":
+            total += layer_fwd_flops(layer, srcs)
+            continue
+        b = srcs[0][0]
+        v = math.prod(srcs[0][1:])
+        h = layer.hdim
+        bvh = 2.0 * b * v * h
+        total += bvh * (1 + 2 * layer.cd_k + 2)
+    return total
+
+
 #: bf16 matmul peak per chip, by device_kind substring (first match wins).
 #: Sources: public TPU system specs (cloud.google.com/tpu/docs/system-*).
 _PEAKS = (

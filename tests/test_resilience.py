@@ -128,19 +128,22 @@ def make_job(
 
 
 def test_fault_plan_grammar():
-    plan = FaultPlan.parse("crash@7, sigterm@12,nanloss@5,slowstep@9=0.5")
+    plan = FaultPlan.parse(
+        "crash@7, sigterm@12,nanloss@5,slowstep@9=0.5,async_torn_write@1"
+    )
     kinds = [(s.kind, s.at, s.value) for s in plan.specs]
     assert kinds == [
         ("crash", 7, None),
         ("sigterm", 12, None),
         ("nanloss", 5, None),
         ("slowstep", 9, 0.5),
+        ("async_torn_write", 1, None),
     ]
     # fire-once: the supervisor shares one plan across restarts, so the
     # resumed run passing step 7 again must NOT re-crash
     assert plan.fire("crash", 7) is not None
     assert plan.fire("crash", 7) is None
-    assert len(plan.unfired()) == 3
+    assert len(plan.unfired()) == 4
     assert not FaultPlan.parse(None)
     assert not FaultPlan.parse("")
 
@@ -191,6 +194,24 @@ def test_retention_keeps_last_n(tmp_path):
     deleted = retention.apply_retention(folder, 2)
     assert sorted(deleted) == sorted(paths[:2])
     assert retention.list_checkpoints(folder) == [paths[3], paths[2]]
+
+
+def test_retention_removes_server_sidecars(tmp_path):
+    """The replica engine's `.server` sidecar (the full center tree)
+    must not outlive its checkpoint — GC'd saves take theirs along."""
+    folder = str(tmp_path)
+    paths = [_fake_ckpt(folder, s) for s in (2, 4, 6)]
+    for p in paths:
+        with open(p + ".server", "wb") as f:
+            f.write(b"sidecar")
+    retention.mark_latest(folder, paths[-1])
+    deleted = retention.apply_retention(folder, 2)
+    assert sorted(deleted) == sorted(paths[:1] + [paths[0] + ".server"])
+    assert sorted(os.listdir(folder)) == [
+        "LATEST",
+        "step_4.npz", "step_4.npz.server",
+        "step_6.npz", "step_6.npz.server",
+    ]
 
 
 def test_gc_stale_shards(tmp_path):
@@ -397,6 +418,230 @@ def test_watchdog_dumps_on_slow_step(tmp_path):
     assert any("MainThread" in d for d in dumps)
 
 
+# ---------------------------------------------------------------------------
+# zero-stall checkpointing (resilience/async_ckpt.py)
+# ---------------------------------------------------------------------------
+
+
+def test_async_crash_auto_resume_matches_uninterrupted_run(tmp_path):
+    """The tentpole acceptance bar: crash@7 under async checkpointing
+    auto-resumes from the async-written step_5 save and finishes with
+    params BITWISE identical to an uninterrupted (sync-path) run."""
+    cfg_a, cl_a, _ = make_job(tmp_path / "a")
+    assert (
+        supervisor.run(cfg_a, cl_a, seed=3, log=lambda s: None,
+                       prefetch=False)
+        == EXIT_OK
+    )
+
+    logs = []
+    cfg_b, cl_b, _ = make_job(
+        tmp_path / "b", resilience="async_checkpoint: true"
+    )
+    rc = supervisor.run(
+        cfg_b, cl_b, seed=3, faults="crash@7", log=logs.append,
+        prefetch=False,
+    )
+    assert rc == EXIT_OK
+    assert any("checkpoint (async)" in l for l in logs)
+    assert any("resumed from" in l and "step_5" in l for l in logs)
+
+    _, pa, _, _ = load_checkpoint(
+        os.path.join(cl_a.workspace, "checkpoints", "step_12.npz")
+    )
+    _, pb, _, _ = load_checkpoint(
+        os.path.join(cl_b.workspace, "checkpoints", "step_12.npz")
+    )
+    assert set(pa) == set(pb)
+    for name in pa:
+        np.testing.assert_array_equal(
+            pa[name], pb[name],
+            err_msg=f"param {name} differs between sync and async paths",
+        )
+
+
+def test_async_torn_write_never_becomes_latest(tmp_path):
+    """async_torn_write@1 kills the writer mid-publish of the first
+    async save: the torn file must never reach LATEST, later saves
+    publish normally, and the run completes."""
+    logs = []
+    cfg, cl, ck_dir = make_job(
+        tmp_path, train_steps=10, checkpoint_frequency=2,
+        resilience="async_checkpoint: true",
+    )
+    rc = supervisor.run(
+        cfg, cl, seed=3, faults="async_torn_write@1", log=logs.append,
+        prefetch=False,
+    )
+    assert rc == EXIT_OK
+    assert any("async_torn_write@1" in l for l in logs)
+    # the torn step_2 was never published: either it still sits there
+    # failing validation, or a later save's retention pass GC'd it as
+    # unrestorable — both prove LATEST never trusted it
+    torn = os.path.join(ck_dir, "step_2.npz")
+    assert not retention.validate_checkpoint(torn)
+    marker = open(os.path.join(ck_dir, "LATEST")).read().strip()
+    assert marker == "step_10.npz"  # the torn save was never marked
+    # and a resume trusts only complete saves
+    assert retention.resolve_latest(ck_dir).endswith("step_10.npz")
+
+
+def test_async_crash_between_snapshot_and_write_resumes_previous(tmp_path):
+    """Torn async write followed by a crash: auto-resume must land on
+    the save BEFORE the torn one (crash@7 comes after step_5's write is
+    torn; the previous complete checkpoint is the config default none —
+    so the supervisor restarts from scratch and still finishes)."""
+    logs = []
+    cfg, cl, ck_dir = make_job(
+        tmp_path, train_steps=12, checkpoint_frequency=5,
+        resilience="async_checkpoint: true",
+    )
+    rc = supervisor.run(
+        cfg, cl, seed=3, faults="async_torn_write@1,crash@7",
+        log=logs.append, prefetch=False,
+    )
+    assert rc == EXIT_OK
+    # step_5 was torn, so the restart could NOT have resumed from it
+    assert not any("resumed from" in l and "step_5" in l for l in logs)
+    final = retention.resolve_latest(ck_dir)
+    assert final is not None and final.endswith("step_12.npz")
+    assert retention.validate_checkpoint(final)
+
+
+def test_async_sigterm_drain_flushes_inflight_write(tmp_path):
+    """sigterm@8 with async checkpointing: the drain must flush the
+    final (async) checkpoint to a complete, LATEST-marked file before
+    the resumable exit — the launcher may relaunch immediately."""
+    logs = []
+    cfg, cl, ck_dir = make_job(
+        tmp_path, train_steps=20, resilience="async_checkpoint: true"
+    )
+    rc = supervisor.run(
+        cfg, cl, seed=3, faults="sigterm@8", log=logs.append,
+        prefetch=False,
+    )
+    assert rc == EXIT_RESUMABLE
+    latest = retention.resolve_latest(ck_dir)
+    assert latest is not None and latest.endswith("step_8.npz")
+    assert retention.validate_checkpoint(latest)
+    marker = open(os.path.join(ck_dir, "LATEST")).read().strip()
+    assert marker == "step_8.npz"
+    # a fresh supervised run picks the drained checkpoint back up
+    logs2 = []
+    rc = supervisor.run(cfg, cl, seed=3, log=logs2.append, prefetch=False)
+    assert rc == EXIT_OK
+    assert any("resumed from" in l and "step_8" in l for l in logs2)
+
+
+def test_async_writer_publishes_in_step_order(tmp_path):
+    """Two rapid checkpoints publish (validate + LATEST) in step order:
+    the FIFO queue + single writer make reordering structurally
+    impossible — pinned here against refactors."""
+    import time
+
+    from singa_tpu.resilience import AsyncCheckpointer
+
+    folder = str(tmp_path)
+    published = []
+    writer = AsyncCheckpointer(log=lambda s: None)
+
+    def job(step, delay):
+        path = os.path.join(folder, f"step_{step}.npz")
+
+        def write():
+            time.sleep(delay)
+            save_checkpoint(path, step, {"w": np.zeros((4,), np.float32)})
+
+        def on_written(p, s):
+            assert retention.validate_checkpoint(p)
+            retention.mark_latest(folder, p)
+            published.append(s)
+
+        writer.submit(step, path, write, on_written)
+
+    job(1, 0.2)  # slow first write...
+    job(2, 0.0)  # ...must still publish before the fast second one
+    writer.flush()
+    writer.stop()
+    assert published == [1, 2]
+    marker = open(os.path.join(folder, "LATEST")).read().strip()
+    assert marker == "step_2.npz"
+
+
+def test_async_backpressure_bounds_snapshots(tmp_path):
+    """A writer slower than the submit cadence must BLOCK submit (double
+    buffer), never queue unboundedly."""
+    import time
+
+    from singa_tpu.resilience import AsyncCheckpointer
+
+    writer = AsyncCheckpointer(log=lambda s: None)
+    for step in range(6):
+        writer.submit(
+            step, str(tmp_path / f"step_{step}.npz"),
+            lambda: time.sleep(0.05),
+        )
+        # 1 being-written + 1 queued + the one just submitted
+        assert writer.in_flight() <= 3
+    writer.flush()
+    writer.stop()
+    assert writer.max_in_flight <= 3
+    assert writer.published == 6
+
+
+def test_async_write_failure_surfaces(tmp_path):
+    """A background write failure (dead disk) must reach the step loop
+    at the next flush/submit — never train on silently unsaved."""
+    from singa_tpu.resilience import AsyncCheckpointer, AsyncWriteError
+
+    logs = []
+    writer = AsyncCheckpointer(log=logs.append)
+
+    def boom():
+        raise OSError("disk on fire")
+
+    writer.submit(1, str(tmp_path / "step_1.npz"), boom)
+    with pytest.raises(AsyncWriteError, match="disk on fire"):
+        writer.flush()
+    assert any("ERROR" in l for l in logs)
+    writer.stop()
+
+
+def test_async_cd_engine_checkpoints(tmp_path):
+    """The CD engine rides the same zero-stall path: async saves from a
+    CDTrainer are complete, LATEST-marked, and resumable."""
+    from test_cd import make_rbm_conf
+
+    from singa_tpu.config.schema import ResilienceConfig
+    from singa_tpu.resilience import FaultPlan, ResilienceContext
+    from singa_tpu.trainer import CDTrainer
+
+    cfg = make_rbm_conf(tmp_path, train_steps=6)
+    cfg.checkpoint_frequency = 2
+    cfg.resilience = ResilienceConfig()
+    cfg.resilience.async_checkpoint = True
+    cluster = ClusterConfig()
+    cluster.workspace = str(tmp_path / "ws")
+    ctx = ResilienceContext(
+        cfg.resilience, FaultPlan(), log=lambda s: None
+    )
+    trainer = CDTrainer(
+        cfg, cluster, seed=0, log=lambda s: None, prefetch=False
+    )
+    ctx.bind(trainer)
+    try:
+        trainer.run()
+        ctx.flush_async()
+    finally:
+        ctx.stop()
+    ck_dir = os.path.join(cluster.workspace, "checkpoints")
+    latest = retention.resolve_latest(ck_dir)
+    assert latest is not None and latest.endswith("step_6.npz")
+    step, params, _, _ = load_checkpoint(latest)
+    assert step == 6
+    assert any(name.endswith("weight") for name in params)
+
+
 def test_guard_rejected_on_non_backprop_engine(tmp_path):
     """Engines that override the train step (CD) must reject a guard
     config loudly instead of silently not guarding."""
@@ -441,6 +686,20 @@ def test_resilience_block_lint_coverage():
         "job.conf", col,
     )
     assert any(d.code == "CFG002" for d in col.sorted())
+    # the zero-stall knob is schema-covered too: a typo gets the
+    # did-you-mean pointing at async_checkpoint
+    col = Collector()
+    lint_model_text(
+        base.replace(
+            "resilience { max_restarts: 3",
+            "resilience { async_checkpont: 1 max_restarts: 3",
+        ),
+        "job.conf", col,
+    )
+    assert any(
+        d.code == "CFG001" and "async_checkpoint" in (d.fix_hint or "")
+        for d in col.sorted()
+    )
 
 
 # ---------------------------------------------------------------------------
