@@ -143,11 +143,15 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
     mfu = (flops / slope) / peak if peak else None
     value = sps * tokens_per_sample if tokens_per_sample else sps
     # host-side phase timers over every timed step (dispatch cost under
-    # the chunked engine; full host loop otherwise)
+    # the chunked engine; full host loop otherwise). The data phase is
+    # ALWAYS reported — a 0.0 row proves input stalls were measured and
+    # absent, instead of hiding them (the BENCH_r* trajectories only
+    # showed `train`, which made an input-bound regression invisible).
     t = trainer.timers
     phase_ms = {
         ph: round(t.total(ph) / timed_steps * 1e3, 4) for ph in t.phases()
     }
+    phase_ms.setdefault("data", 0.0)
     return {
         "name": name,
         "value": round(value, 1),
@@ -159,6 +163,9 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
         "model_flops": flops,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "phase_ms": phase_ms,
+        # which input path fed the row (cached / stream / prefetch /
+        # sync) — regressions stay attributable to the feeder mode
+        "feeder": trainer.feeder_mode,
         "method": "two-window slope fit (marginal per-step cost)",
     }
 

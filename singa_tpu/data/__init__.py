@@ -10,6 +10,7 @@ device arrays to the jitted train step.
 from .records import ImageRecord, decode_record, encode_record
 from .shard import ShardReader, ShardWriter
 from .pipeline import BatchPipeline, load_shard_arrays
+from .device_prefetch import ChunkStager, DeviceFeeder, InputFeedError
 
 __all__ = [
     "ImageRecord",
@@ -19,4 +20,7 @@ __all__ = [
     "ShardWriter",
     "BatchPipeline",
     "load_shard_arrays",
+    "DeviceFeeder",
+    "ChunkStager",
+    "InputFeedError",
 ]

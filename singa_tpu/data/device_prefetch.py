@@ -1,0 +1,251 @@
+"""Device-side input prefetch: the other half of the Prefetching protocol.
+
+The reference overlaps input with compute via the double-buffered
+``ParserLayer::Prefetching`` protocol (include/worker/base_layer.h:510-537)
+— while batch k trains, a thread assembles batch k+1 into the *other*
+buffer. Our ``BatchPipeline`` reproduced only the host-side half of that:
+the gather ran ahead, but every step still paid a synchronous
+``jax.device_put`` (and, for the scan-chunk engine, device-cached datasets
+were the only way to keep the host off the step path at all).
+
+This module is the device-side half, in two grain sizes:
+
+  ``DeviceFeeder`` — per-step double buffering. A daemon thread assembles
+      batch k+1 on the host AND starts its ``jax.device_put`` to the
+      batch shardings while step k runs; the trainer's ``_next_batch``
+      becomes a buffer swap. The transfer overlaps compute (device_put
+      is asynchronous — the arrays commit before the step that consumes
+      them dispatches).
+
+  ``ChunkStager`` — chunk-granularity double buffering for streaming
+      ``lax.scan`` windows. While one staged block (the next N batches,
+      stacked into one host→device transfer) is consumed by a running
+      scan, the thread stages the following block. Memory is bounded at
+      TWO blocks (one consuming + one staged): the thread waits on a
+      slot before staging, it never runs ahead of that.
+
+Stream semantics are preserved exactly:
+
+  - batches/blocks come out in sequential wraparound order — the same
+    index math as the synchronous path (the stager owns a private record
+    cursor; the feeder drives the pipelines themselves, on one thread);
+  - consumed positions are tracked per batch actually handed to the
+    trainer, so a checkpoint written at a step boundary never skips
+    read-ahead the trainer did not see (`consumed_positions`);
+  - ``reset()`` discards all read-ahead and joins the thread, so a
+    checkpoint restore (or guard rollback) can re-seek the streams and
+    restart deterministically.
+
+Both classes surface a worker-thread exception on the next ``next()`` /
+``take()`` instead of dying silently.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class InputFeedError(RuntimeError):
+    """A background input-feeder thread failed; re-raised on the step
+    path so the trainer cannot silently train on missing data."""
+
+
+class _Prefetcher:
+    """Shared thread scaffolding: slot-bounded production, FIFO handoff,
+    error surfacing, and a drain-and-join ``reset``."""
+
+    #: blocks/batches staged-but-unconsumed at once (the double buffer's
+    #: read-ahead side; the consumer's in-use item is the other half)
+    _SLOTS = 1
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._slots = threading.Semaphore(self._SLOTS)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    # -- producer side -------------------------------------------------
+
+    def _produce(self):
+        """One item, or None to end the stream. Runs on the thread."""
+        raise NotImplementedError
+
+    def _run(self) -> None:
+        while True:
+            self._slots.acquire()
+            if self._stop.is_set():
+                return
+            try:
+                item = self._produce()
+            except BaseException as e:
+                self._error = e
+                self._q.put(None)  # wake a blocked consumer
+                return
+            if item is None:
+                # end of stream: leave a marker so a consumer that asks
+                # for one item too many fails loudly instead of hanging
+                self._q.put(None)
+                return
+            self._q.put(item)
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=type(self).__name__, daemon=True
+        )
+        self._thread.start()
+
+    # -- consumer side -------------------------------------------------
+
+    def _get(self):
+        item = self._q.get()
+        self._slots.release()
+        if item is None:
+            err = self._error
+            # park the dead thread NOW: a caller that catches the error
+            # and retries must restart production (and fail loudly again
+            # if the condition persists), never block on an empty queue
+            self.reset()
+            if err is not None:
+                raise InputFeedError(
+                    f"background input feeder failed: "
+                    f"{type(err).__name__}: {err}"
+                ) from err
+            raise InputFeedError("input feeder ended early")
+        return item
+
+    def reset(self) -> None:
+        """Discard every read-ahead item and join the thread. After this
+        the caller may re-seek the underlying streams; production
+        restarts lazily on the next request."""
+        t = self._thread
+        if t is not None:
+            self._stop.set()
+            self._slots.release()  # unblock a producer waiting for a slot
+            while t.is_alive():
+                try:  # unblock a producer mid-put, then let it exit
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.02)
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread = None
+        self._stop = threading.Event()
+        self._slots = threading.Semaphore(self._SLOTS)
+        self._error = None
+
+
+class DeviceFeeder(_Prefetcher):
+    """Per-step double-buffered device feeder.
+
+    ``assemble()`` runs on the feeder thread: it consumes one batch from
+    the pipelines, starts its ``jax.device_put`` to the right shardings,
+    and returns the batch dict — identical arrays, identical placement,
+    to the synchronous path. ``positions()`` (same thread, right after)
+    snapshots the stream positions AFTER that batch; the value travels
+    with the batch so ``consumed_positions`` always reflects exactly the
+    batches the trainer has taken, never the thread's read-ahead.
+    """
+
+    _SLOTS = 1  # one batch staged ahead + the one the step consumes
+
+    def __init__(self, assemble, positions):
+        super().__init__()
+        self._assemble = assemble
+        self._positions = positions
+        #: stream positions after the last batch handed to the trainer
+        #: (checkpoints persist THESE, not the pipelines' read-ahead)
+        self.consumed_positions: dict[str, int] = {}
+
+    def _produce(self):
+        batch = self._assemble()
+        return batch, dict(self._positions())
+
+    def next(self) -> dict:
+        """The buffer swap: return the already-transferred next batch
+        and kick assembly of the one after."""
+        if self._thread is None:
+            self._start()
+        batch, pos = self._get()
+        self.consumed_positions = pos
+        return batch
+
+    def reset(self) -> None:
+        super().reset()
+        self.consumed_positions = {}
+
+
+class ChunkStager(_Prefetcher):
+    """Chunk-granularity double buffering for streaming scan windows.
+
+    The stager owns a private wraparound cursor per stream (initialized
+    from the pipelines at start) and follows the trainer's deterministic
+    chunk schedule: block k covers ``schedule(step_k)`` steps starting
+    where block k-1 ended. ``take(step0, nsteps)`` hands the staged
+    block over (stacked ``(nsteps * batches_per_step * batchsize, ...)``
+    arrays, already committed to the device) together with the stream
+    positions after it, and unblocks staging of the next block. A
+    schedule mismatch (the trainer asked for a window the stager did not
+    predict) raises instead of silently feeding wrong records.
+    """
+
+    _SLOTS = 1  # one block staged ahead + the one the scan consumes
+
+    def __init__(self, sources, batches_per_step, schedule, cursors, put):
+        """``sources``: {layer: (images, labels, batchsize)} host arrays;
+        ``schedule(step) -> nsteps`` (0 ends the stream);
+        ``cursors() -> {layer: record position}`` read at start;
+        ``put(np_array) -> device array`` commits a staged block."""
+        super().__init__()
+        self._sources = sources
+        self._bps = batches_per_step
+        self._schedule = schedule
+        self._cursors = cursors
+        self._put = put
+        self._step: int | None = None
+        self._pos: dict[str, int] = {}
+
+    def _produce(self):
+        nsteps = int(self._schedule(self._step))
+        if nsteps <= 0:
+            return None
+        block: dict = {}
+        positions: dict[str, int] = {}
+        for name, (images, labels, bs) in self._sources.items():
+            n = len(images)
+            span = nsteps * self._bps * bs
+            idx = (self._pos[name] + np.arange(span)) % n
+            block[name] = {
+                "image": self._put(images[idx]),
+                "label": self._put(labels[idx]),
+            }
+            self._pos[name] = int((self._pos[name] + span) % n)
+            positions[name] = self._pos[name]
+        step0, self._step = self._step, self._step + nsteps
+        return step0, nsteps, block, positions
+
+    def take(self, step0: int, nsteps: int):
+        """-> (block, positions_after) for the window
+        ``[step0, step0 + nsteps)``."""
+        if self._thread is None:
+            self._step = int(step0)
+            self._pos = {k: int(v) for k, v in self._cursors().items()}
+            self._start()
+        s, n, block, positions = self._get()
+        if (s, n) != (step0, nsteps):
+            # discard the whole read-ahead before raising: a caller that
+            # survives the error must restart from fresh cursors, not
+            # keep draining a schedule that already diverged
+            self.reset()
+            raise InputFeedError(
+                f"chunk stager staged window ({s}, {n}) but the trainer "
+                f"asked for ({step0}, {nsteps}) — schedule drift"
+            )
+        return block, positions

@@ -1,19 +1,20 @@
-"""Host-side input pipeline: shard -> batched numpy arrays -> device.
+"""Host-side input pipeline: shard -> batched numpy arrays.
 
-Replaces the reference's DataLayer/prefetch machinery
-(ShardDataLayer::ComputeFeature, src/worker/layer.cc:646-673; the
-double-buffered ParserLayer::Prefetching protocol,
-include/worker/base_layer.h:510-537). Parsing/normalization itself is NOT
-done here — parser layers are elementwise math and live inside the jitted
-step where XLA fuses them for free; this pipeline just delivers raw record
-batches with the reference's sequencing semantics (sequential reads with
-wraparound, one-time random_skip) plus a background prefetch thread.
+Replaces the reference's DataLayer machinery
+(ShardDataLayer::ComputeFeature, src/worker/layer.cc:646-673). Parsing/
+normalization itself is NOT done here — parser layers are elementwise
+math and live inside the jitted step where XLA fuses them for free; this
+pipeline just delivers raw record batches with the reference's
+sequencing semantics (sequential reads with wraparound, one-time
+random_skip). Read-ahead — the double-buffered ParserLayer::Prefetching
+protocol (include/worker/base_layer.h:510-537) — lives one level up, in
+data/device_prefetch.py: its feeders drive a pipeline from ONE thread
+and overlap the device transfer too, which keeps this class thread-free
+and therefore seek()-able at any point (checkpoint resume, guard
+rollback).
 """
 
 from __future__ import annotations
-
-import queue
-import threading
 
 import numpy as np
 
@@ -108,13 +109,14 @@ def load_lmdb_arrays(path: str) -> tuple[np.ndarray, np.ndarray]:
 
 
 class BatchPipeline:
-    """Batched sequential iteration with wraparound and prefetch.
+    """Batched sequential iteration with wraparound.
 
     Mirrors ShardDataLayer semantics: records are consumed in file order,
     wrapping at the end; ``random_skip`` skips ``rand() % random_skip``
-    records once at startup (layer.cc:646-656). ``prefetch`` overlaps the
-    next batch's host work with device compute via a daemon thread (the
-    reference's Prefetching protocol).
+    records once at startup (layer.cc:646-656). Read-ahead lives in the
+    device feeders (data/device_prefetch.py), which overlap the device
+    transfer as well and keep this class single-threaded — so ``seek``
+    works at any point in a run.
     """
 
     def __init__(
@@ -124,7 +126,6 @@ class BatchPipeline:
         batchsize: int,
         *,
         random_skip: int = 0,
-        prefetch: bool = True,
         seed: int | None = None,
     ):
         if len(images) != len(labels):
@@ -133,33 +134,27 @@ class BatchPipeline:
         self.labels = labels
         self.batchsize = batchsize
         self.n = len(images)
-        self._pos = 0  # producer cursor (runs ahead under prefetch)
+        self._pos = 0  # cursor (record index of the next unread batch)
         if random_skip:
             rng = np.random.RandomState(seed)
             self._pos = int(rng.randint(0, random_skip)) % self.n
-        # CONSUMED position bookkeeping: position is derived from batches
-        # actually handed to the trainer, not the producer cursor — under
-        # prefetch the queue holds batches the trainer never saw, and a
-        # checkpoint must not skip those on resume.
+        # CONSUMED bookkeeping: position derives from batches handed out,
+        # relative to the post-skip start. A device feeder consuming this
+        # pipeline from its thread reads ahead of the trainer; it tracks
+        # the trainer-consumed view itself (DeviceFeeder.consumed_positions).
         self._start = self._pos
         self._consumed = 0
-        self._prefetch = prefetch
-        self._queue: queue.Queue | None = None
-        self._thread: threading.Thread | None = None
 
     @property
     def position(self) -> int:
-        """Stream position (record index of the next batch the TRAINER
-        will see). Checkpoints persist this; seek() restores it. The
-        one-time random_skip draw is baked into it, so resume needs no
-        separate RNG state."""
+        """Stream position (record index of the next batch). Checkpoints
+        persist this; seek() restores it. The one-time random_skip draw
+        is baked into it, so resume needs no separate RNG state."""
         return int((self._start + self._consumed * self.batchsize) % self.n)
 
     def seek(self, pos: int) -> None:
-        """Reposition the stream (checkpoint resume). Must happen before
-        the prefetch thread starts."""
-        if self._thread is not None:
-            raise RuntimeError("seek() after prefetch started")
+        """Reposition the stream (checkpoint resume / guard rollback /
+        the chunk stager's window-boundary re-sync)."""
         self._pos = int(pos) % self.n
         self._start = self._pos
         self._consumed = 0
@@ -167,8 +162,6 @@ class BatchPipeline:
     def advance(self, nsteps: int) -> None:
         """Skip ``nsteps`` batches: the device-side chunk engine consumed
         them via on-device index math (Trainer.train_chunk)."""
-        if self._thread is not None:
-            raise RuntimeError("advance() after prefetch started")
         self._pos = int((self._pos + nsteps * self.batchsize) % self.n)
         self._consumed += nsteps
 
@@ -180,32 +173,15 @@ class BatchPipeline:
     def next_indices(self) -> np.ndarray:
         """Advance the stream and return the batch's record indices
         without materializing arrays (device-cached datasets gather on
-        device). Do not mix with a running prefetch thread."""
-        if self._thread is not None:
-            raise RuntimeError("next_indices() after prefetch started")
+        device)."""
         idx = self._next_indices()
         self._consumed += 1
         return idx
 
     def next_batch(self) -> tuple[np.ndarray, np.ndarray]:
-        if self._prefetch:
-            if self._queue is None:
-                self._queue = queue.Queue(maxsize=2)
-                self._thread = threading.Thread(
-                    target=self._producer, daemon=True
-                )
-                self._thread.start()
-            item = self._queue.get()
-            self._consumed += 1
-            return item
         idx = self._next_indices()
         self._consumed += 1
         return self.images[idx], self.labels[idx]
-
-    def _producer(self) -> None:
-        while True:
-            idx = self._next_indices()
-            self._queue.put((self.images[idx], self.labels[idx]))
 
     def steps_per_epoch(self) -> int:
         return max(1, self.n // self.batchsize)

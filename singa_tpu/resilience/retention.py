@@ -82,11 +82,70 @@ def _sharded_valid(path: str) -> bool:
     return True
 
 
+#: validation cache: path -> fingerprint of the last content this module
+#: fully CRC-validated. The per-save retention pass re-walks EVERY kept
+#: checkpoint through validate_checkpoint; without the cache that walk
+#: (zipfile.testzip CRC over every member of every archive) grows with
+#: keep_last and bounds the async writer's throughput. A fingerprint is
+#: (mtime_ns, size) per constituent file, so any rewrite/tear/truncation
+#: forces a real re-validation.
+_VALIDATED: dict[str, tuple] = {}
+_VALIDATED_CAP = 256
+
+
+def validation_cache_clear() -> None:
+    """Drop every cached validation verdict (tests; paranoia)."""
+    _VALIDATED.clear()
+
+
+def _fingerprint(path: str) -> tuple | None:
+    """Stat-level identity of a checkpoint's bytes, or None when it
+    cannot be stat'ed (never cache what cannot be re-checked)."""
+    try:
+        if os.path.isdir(path):
+            names = ["manifest.json"] + sorted(
+                f for f in os.listdir(path) if _PROC_RE.match(f)
+            )
+            fp = []
+            for name in names:
+                st = os.stat(os.path.join(path, name))
+                fp.append((name, st.st_mtime_ns, st.st_size))
+            return tuple(fp)
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+
+
+def _forget_validated(path: str) -> None:
+    _VALIDATED.pop(path, None)
+
+
 def validate_checkpoint(path: str) -> bool:
-    """True iff ``path`` is a complete, readable checkpoint."""
+    """True iff ``path`` is a complete, readable checkpoint.
+
+    Positive verdicts are cached by content fingerprint: a checkpoint
+    this process already CRC-validated is only re-walked when its files'
+    (mtime, size) changed. Negative verdicts are never cached — a save
+    that looks torn may simply still be in flight."""
+    fp = _fingerprint(path)
+    if fp is not None and _VALIDATED.get(path) == fp:
+        return True
     if os.path.isdir(path):
-        return _sharded_valid(path)
-    return os.path.isfile(path) and _npz_valid(path)
+        ok = _sharded_valid(path)
+    else:
+        ok = os.path.isfile(path) and _npz_valid(path)
+    if ok and fp is not None:
+        # the fingerprint was taken BEFORE the walk: if a concurrent
+        # writer changed the file mid-validation, the stale fingerprint
+        # mismatches next time and forces a re-check — the safe side
+        if len(_VALIDATED) >= _VALIDATED_CAP:
+            for stale in [p for p in _VALIDATED if not os.path.exists(p)]:
+                _VALIDATED.pop(stale, None)
+            if len(_VALIDATED) >= _VALIDATED_CAP:
+                _VALIDATED.clear()  # pathological churn; correctness first
+        _VALIDATED[path] = fp
+    return ok
 
 
 def list_checkpoints(folder: str) -> list[str]:
@@ -170,6 +229,7 @@ def apply_retention(folder: str, keep_last: int) -> list[str]:
             else:
                 os.unlink(path)
             deleted.append(path)
+            _forget_validated(path)
         except OSError:
             pass
         # the replica engine writes a `<ckpt>.server` sidecar (center +

@@ -79,6 +79,7 @@ class ReplicaTrainer(Trainer):
         log: Callable[[str], None] = print,
         prefetch: bool | None = None,
         device_cache: bool | None = None,
+        stream_chunks: bool | None = None,
     ):
         ucfg = model_cfg.updater
         if ucfg is None:
@@ -117,6 +118,7 @@ class ReplicaTrainer(Trainer):
             log=log,
             prefetch=prefetch,
             device_cache=device_cache,
+            stream_chunks=stream_chunks,
         )
         # each step consumes one batch per replica
         self._batch_size = self.train_net.batchsize * self.nreplicas
@@ -248,18 +250,24 @@ class ReplicaTrainer(Trainer):
 
         With the device-cached dataset only a (replicas, batch) index
         grid crosses to the device; the gather happens inside the jitted
-        step (Trainer._resolve_batch handles the 2-D index)."""
-        if net is not self.train_net:
+        step (Trainer._resolve_batch handles the 2-D index). Non-cached
+        routing (device feeder / host assembly) is the base class's —
+        it lands in _assemble_host_batch below either way."""
+        if net is not self.train_net or not self._cached:
             return super()._next_batch(net)
         out = {}
-        if self._cached:
-            for name, pipe in self._pipelines[id(net)].items():
-                d = self._dev_data[id(net)][name]
-                idx = np.stack(
-                    [pipe.next_indices() for _ in range(self.nreplicas)]
-                )
-                out[name] = {"__idx__": jnp.asarray(idx), **d}
-            return out
+        for name, pipe in self._pipelines[id(net)].items():
+            d = self._dev_data[id(net)][name]
+            idx = np.stack(
+                [pipe.next_indices() for _ in range(self.nreplicas)]
+            )
+            out[name] = {"__idx__": jnp.asarray(idx), **d}
+        return out
+
+    def _assemble_host_batch(self, net) -> dict:
+        if net is not self.train_net:
+            return super()._assemble_host_batch(net)
+        out = {}
         leaf_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         for name, pipe in self._pipelines[id(net)].items():
             imgs, labels = [], []
@@ -272,6 +280,13 @@ class ReplicaTrainer(Trainer):
                 "label": jax.device_put(np.stack(labels), leaf_sh),
             }
         return out
+
+    def _step_via_chunk(self, step: int) -> bool:
+        """Warmup steps must run through train_one_batch (their
+        wall-clock feeds SyncConfig and the bootstrap fires between
+        them); the streaming stager only starts once the schedule is
+        stable — i.e. post-bootstrap."""
+        return self._bootstrapped and step >= self.warmup_steps
 
     def _chunk_batch_indices(self, pos0, i, bs: int, n: int):
         """Scan-iteration i's (replicas, batch) index grid: replica r
@@ -363,18 +378,20 @@ class ReplicaTrainer(Trainer):
 
     def _make_fused_chunk_fn(self, nwindows: int, wlen: int):
         """jit(nwindows x (wlen-step inner scan + protocol round)): sync
-        windows and their rounds reconcile in ONE compiled program."""
-        body = self._chunk_body(wlen)
+        windows and their rounds reconcile in ONE compiled program.
+
+        Meta spans the WHOLE multi-window range: device-cached, gathers
+        wrap over the full dataset; streaming, each inner window indexes
+        its slice of the one staged nwindows*wlen-step block."""
+        meta = self._chunk_meta(nwindows * wlen)
+        body = self._chunk_body(wlen, meta=meta)
         pipes = self._pipelines[id(self.train_net)]
         # per-stream position advance of one window
         adv = {
             name: wlen * self._batches_per_step * pipes[name].batchsize
-            for name in self._dev_data[id(self.train_net)]
+            for name in meta
         }
-        nrec = {
-            name: pipes[name].n
-            for name in self._dev_data[id(self.train_net)]
-        }
+        nrec = {name: meta[name][1] for name in meta}
         elastic = self.protocol == "Elastic"
         alpha = (
             self.moving_rate if self.moving_rate > 0 else self.sample_ratio

@@ -85,6 +85,7 @@ class Trainer:
         log: Callable[[str], None] = print,
         prefetch: bool | None = None,
         device_cache: bool | None = None,
+        stream_chunks: bool | None = None,
     ):
         self.cfg = model_cfg
         self.cluster = cluster_cfg
@@ -172,9 +173,15 @@ class Trainer:
         self.buffers: dict = {}
         self._materialize_params()
 
-        # --- input pipelines (prefetch thread; base_layer.h:510-537) ---
+        # --- input pipelines (the Prefetching protocol's host half;
+        # base_layer.h:510-537). Pipeline-level prefetch threads stay
+        # OFF: with ``prefetch`` on, the DEVICE feeder / chunk stager
+        # (data/device_prefetch.py) own the read-ahead thread — it does
+        # the host gather AND starts the transfer, and keeping the
+        # pipelines thread-free keeps them seek()-able for rollback ---
         if prefetch is None:
             prefetch = model_cfg.prefetch
+        self._prefetch_input = bool(prefetch)
         self._pipelines: dict[int, dict[str, BatchPipeline]] = {}
         for net in (self.train_net, self.test_net, self.val_net):
             if net is None:
@@ -185,7 +192,6 @@ class Trainer:
                     l.labels,
                     l.batchsize,
                     random_skip=l.random_skip if net is self.train_net else 0,
-                    prefetch=prefetch and net is self.train_net,
                     seed=seed,
                 )
                 for l in net.datalayers
@@ -205,7 +211,30 @@ class Trainer:
         # reference's per-step shard read + prefetch copy has no useful
         # counterpart once the data already lives in HBM.
         self._dev_data: dict[int, dict[str, dict]] = {}
+        #: (net id, layer) -> decoded dtype for uint8-compacted device
+        #: data (cached datasets AND streaming staged blocks)
+        self._cache_cast: dict[tuple[int, str], jnp.dtype] = {}
         self._cached = self._maybe_cache_datasets(device_cache)
+
+        # --- zero-stall input (data/device_prefetch.py): with prefetch
+        # on and no device cache, train batches arrive double-buffered —
+        # per-step via the device feeder, or as staged scan-chunk blocks
+        # (feeder_mode: cached / stream / prefetch / sync) ---
+        if stream_chunks is None:
+            stream_chunks = os.environ.get(
+                "SINGA_TPU_STREAM_CHUNK", "1"
+            ).lower() not in ("0", "off", "false")
+        self._stream_chunks = bool(stream_chunks)
+        self._feeder = None
+        self._stager = None
+        #: train-stream positions of batches the trainer actually
+        #: consumed (the device feeder reads ahead; checkpoints must not
+        #: skip what the step loop never saw)
+        self._feeder_positions: dict[str, int] = {}
+        if self.feeder_mode != "stream":
+            # only the chunk stager consumes the over-budget compaction
+            # stash; don't pin a dataset-sized copy for any other mode
+            self.__dict__.pop("_compact_train", None)
 
         if model_cfg.checkpoint_frequency and self._checkpoint_dir() is None:
             self.log(
@@ -296,7 +325,10 @@ class Trainer:
 
     def _seek_resumed_streams(self) -> None:
         """Apply ``_resume_streams`` to every pipeline (used at init and
-        again after a guard rollback re-restores a checkpoint)."""
+        again after a guard rollback re-restores a checkpoint). Any
+        input feeder's read-ahead is discarded FIRST — its thread must
+        be parked before the streams it draws from are repositioned."""
+        self._reset_feeders()
         for net in (self.train_net, self.test_net, self.val_net):
             if net is None:
                 continue
@@ -483,10 +515,17 @@ class Trainer:
         if enabled is None:
             limit = float(os.environ.get("SINGA_TPU_DEVICE_CACHE_MB", "512"))
             if total > limit * 1e6:
+                # over budget -> the stream stager will want exactly the
+                # train net's compacted arrays; hand them over instead of
+                # re-scanning (and re-copying) a cache-sized dataset
+                self._compact_train = {
+                    name: compact[(nid, name)]
+                    for nid, name in compact
+                    if nid == id(self.train_net)
+                }
                 return False
         if total == 0:
             return False
-        self._cache_cast: dict[tuple[int, str], jnp.dtype] = {}
         for net in nets:
             self._dev_data[id(net)] = {}
             for l in net.datalayers:
@@ -630,19 +669,146 @@ class Trainer:
         return self._eval_steps[id(net)]
 
     # ------------------------------------------------------------------
+    # input feeders (data/device_prefetch.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def feeder_mode(self) -> str:
+        """How train batches reach the device:
+
+        ``cached``    whole dataset resident in HBM, on-device index
+                      gather inside the jitted step
+        ``stream``    staged scan-chunk blocks, double-buffered at chunk
+                      granularity (the streaming chunk engine)
+        ``prefetch``  per-step double-buffered device feeder (batch k+1
+                      transfers while step k runs)
+        ``sync``      batch assembly + transfer on the step path (the
+                      reference's unprefetched behavior)
+        """
+        if self._cached:
+            return "cached"
+        if self._prefetch_input and self._stream_ok():
+            return "stream"
+        if self._prefetch_input:
+            return "prefetch"
+        return "sync"
+
+    def _stream_ok(self) -> bool:
+        """Streaming chunks share every non-cache opt-out with
+        _can_chunk: debug wants per-step batches, a pending fault plan
+        wants exact step boundaries, SINGA_TPU_CHUNK=1 is the escape
+        hatch; SINGA_TPU_STREAM_CHUNK=0 disables just this mode."""
+        if not self._stream_chunks or self.cfg.debug:
+            return False
+        if self.resilience is not None and self.resilience.per_step:
+            return False
+        return self._chunk_cap() > 1
+
+    def _reset_feeders(self) -> None:
+        """Discard all feeder read-ahead and park the threads (restore /
+        rollback paths — the streams are about to be re-seeked)."""
+        for f in (getattr(self, "_feeder", None),
+                  getattr(self, "_stager", None)):
+            if f is not None:
+                f.reset()
+        self._feeder_positions = {}
+
+    def _device_feeder(self):
+        """The per-step double-buffered device feeder, lazily built."""
+        if self._feeder is None:
+            from ..data.device_prefetch import DeviceFeeder
+
+            # prefetch mode never stages blocks; a stash kept because
+            # the mode was "stream" until a fault plan bound is dead
+            self.__dict__.pop("_compact_train", None)
+            net = self.train_net
+            pipes = self._pipelines[id(net)]
+
+            def positions():
+                return {
+                    f"{net.phase}|{name}": pipe.position
+                    for name, pipe in pipes.items()
+                }
+
+            self._feeder = DeviceFeeder(
+                lambda: self._assemble_host_batch(net), positions
+            )
+        return self._feeder
+
+    def _chunk_stager(self):
+        """The streaming-chunk block stager, lazily built. Byte-valued
+        datasets stage uint8 (the device-cache compaction, decided ONCE
+        over the full array so the staged dtype never flips mid-run);
+        _resolve_batch restores the decoded dtype inside the program."""
+        if self._stager is None:
+            from ..data.device_prefetch import ChunkStager
+
+            net = self.train_net
+            pipes = self._pipelines[id(net)]
+            # consume the compaction _maybe_cache_datasets already did
+            # for the over-budget datasets stream mode targets (POP: the
+            # stager owns the arrays from here, no second copy lives on)
+            stash = self.__dict__.pop("_compact_train", {})
+            sources = {}
+            for name, pipe in pipes.items():
+                arr, orig = stash.get(name) or self._compact_cache_array(
+                    np.asarray(pipe.images)
+                )
+                if arr.dtype != orig:
+                    self._cache_cast[(id(net), name)] = jnp.dtype(orig)
+                sources[name] = (arr, pipe.labels, pipe.batchsize)
+            self._stager = ChunkStager(
+                sources,
+                self._batches_per_step,
+                schedule=self._stream_schedule,
+                cursors=lambda: {
+                    name: pipe.position for name, pipe in pipes.items()
+                },
+                put=lambda a: jax.device_put(jnp.asarray(a), self._repl),
+            )
+        return self._stager
+
+    def _stream_schedule(self, step: int) -> int:
+        """The stager's window-length oracle: exactly the run() loop's
+        chunk lengths (deterministic in ``step``), 0 past the end."""
+        if step >= self.cfg.train_steps:
+            return 0
+        return self._chunk_len(step)
+
+    def _step_via_chunk(self, step: int) -> bool:
+        """Whether a length-1 window in stream mode still runs through
+        train_chunk (keeping the stager's schedule unbroken). Subclasses
+        with a per-step warmup phase (the replica trainer) defer."""
+        del step
+        return True
+
+    # ------------------------------------------------------------------
     # host-side loop
     # ------------------------------------------------------------------
 
     def _next_batch(self, net: Net) -> dict:
-        """Assemble + shard one batch dict for ``net``'s data layers."""
-        out = {}
+        """One batch dict for ``net``'s data layers: index feeds
+        (device-cached), a feeder buffer swap (prefetch mode), or
+        host assembly + transfer on the calling thread."""
         if self._cached:
+            out = {}
             for name, pipe in self._pipelines[id(net)].items():
                 d = self._dev_data[id(net)][name]
                 out[name] = {
                     "__idx__": jnp.asarray(pipe.next_indices()), **d
                 }
             return out
+        if net is self.train_net and self.feeder_mode == "prefetch":
+            feeder = self._device_feeder()
+            batch = feeder.next()
+            self._feeder_positions = dict(feeder.consumed_positions)
+            return batch
+        return self._assemble_host_batch(net)
+
+    def _assemble_host_batch(self, net: Net) -> dict:
+        """Host-side batch assembly + device_put (the synchronous path;
+        also the body the device feeder runs on its thread)."""
+        out = {}
         for name, pipe in self._pipelines[id(net)].items():
             images, labels = pipe.next_batch()
             sh = self.batch_sh.get(name)
@@ -702,16 +868,36 @@ class Trainer:
         trainer overrides with a (replicas, batch) grid)."""
         return self._flat_batch_indices(pos0, i, bs, n)
 
-    def _chunk_body(self, nsteps: int) -> Callable:
-        """The UNJITTED nsteps-step scan body: (params, state, buffers,
-        step0, pos0s, data) -> (params, state, buffers, summed_metrics).
-        _make_chunk_fn jits it; the replica trainer composes it with a
-        protocol round in one program (fused sync windows)."""
+    def _chunk_meta(self, nsteps: int) -> dict[str, tuple[int, int]]:
+        """{layer: (batchsize, gather length)} for a chunk program over
+        ``nsteps`` steps: the device-cached dataset's record count, or —
+        streaming — the staged block's length. With pos0 = 0 and n = the
+        block length, the SAME wraparound index math that walks the
+        cached dataset walks the staged block row-exactly (the real
+        stream's wraparound was applied at staging time, on the host)."""
         pipes = self._pipelines[id(self.train_net)]
-        meta = {
+        if self.feeder_mode == "stream":
+            return {
+                name: (
+                    pipe.batchsize,
+                    nsteps * self._batches_per_step * pipe.batchsize,
+                )
+                for name, pipe in pipes.items()
+            }
+        return {
             name: (pipes[name].batchsize, pipes[name].n)
             for name in self._dev_data[id(self.train_net)]
         }
+
+    def _chunk_body(self, nsteps: int, meta=None) -> Callable:
+        """The UNJITTED nsteps-step scan body: (params, state, buffers,
+        step0, pos0s, data) -> (params, state, buffers, summed_metrics).
+        _make_chunk_fn jits it; the replica trainer composes it with a
+        protocol round in one program (fused sync windows — which pass
+        the WHOLE multi-window meta so inner windows index into the
+        full staged block)."""
+        if meta is None:
+            meta = self._chunk_meta(nsteps)
 
         # the cached dataset enters as an ARGUMENT, not a closure capture:
         # captured arrays lower to embedded constants, which some runtimes
@@ -765,22 +951,39 @@ class Trainer:
         ``fn(params, state, buffers, *extra_in, step0, pos0s, data) ->
         (params, state, buffers, *extra_out, summed_metrics)``;
         ``extra_out`` (protocol state carried through a fused program)
-        is handed to _store_chunk_extras."""
+        is handed to _store_chunk_extras. ``data`` is the device-cached
+        dataset, or — streaming — the double-buffered staged block
+        (normally already transferred; the data phase then times only
+        the buffer swap)."""
         pipes = self._pipelines[id(self.train_net)]
-        pos0s = {
-            name: jnp.int32(pipe.position) for name, pipe in pipes.items()
-        }
+        streaming = self.feeder_mode == "stream"
+        with self.timers.phase("data"):
+            if streaming:
+                data, after = self._chunk_stager().take(step0, nsteps)
+                pos0s = {name: jnp.int32(0) for name in pipes}
+            else:
+                pos0s = {
+                    name: jnp.int32(pipe.position)
+                    for name, pipe in pipes.items()
+                }
+                data = self._dev_data[id(self.train_net)]
         with self.timers.phase("train"):
             out = fn(
                 self.params, self.state, self.buffers, *extra_in,
-                jnp.int32(step0), pos0s,
-                self._dev_data[id(self.train_net)],
+                jnp.int32(step0), pos0s, data,
             )
         self.params, self.state, self.buffers, *extra_out, summed = out
         if extra_out:
             self._store_chunk_extras(tuple(extra_out))
-        for name, pipe in pipes.items():
-            pipe.advance(nsteps * self._batches_per_step)
+        if streaming:
+            # the stager owns the stream cursor (its thread must not
+            # race the pipelines); re-sync the pipelines at the window
+            # boundary so checkpoints see the consumed position
+            for name, pipe in pipes.items():
+                pipe.seek(after[name])
+        else:
+            for name, pipe in pipes.items():
+                pipe.advance(nsteps * self._batches_per_step)
         # metrics arrive pre-summed over the chunk; Performance pulls to
         # host only at display time
         self.perf.update_summed(summed, nsteps)
@@ -932,6 +1135,15 @@ class Trainer:
             t = self.timers.total("train") + self.timers.total("data")
             if t > 0:
                 sps = self.perf.count * self._batch_size / t
+            # input-stall readout (the guard-counter pattern): per-window
+            # data time and its share of the step path, straight from the
+            # timers' existing aggregation — no new per-step host syncs
+            stall = ""
+            if t > 0:
+                stall = (
+                    f" data {self.timers.mean_ms('data'):.1f}ms "
+                    f"({100.0 * self.timers.share('data', 'train'):.0f}%)"
+                )
             # divergence-guard counters ride the display line (ONE host
             # sync, at display cadence — never per step); rollbacks are
             # the context's count
@@ -945,7 +1157,8 @@ class Trainer:
                 )
             self.log(
                 f"step {step}: train {self.perf.to_string()} "
-                f"[{self.timers.to_string()}; {sps:.0f} samples/s]{guard}"
+                f"[{self.timers.to_string()}; {sps:.0f} samples/s]"
+                f"{stall}{guard}"
             )
             if cfg.debug:
                 self.log(self.debug_string(step))
@@ -981,7 +1194,11 @@ class Trainer:
             for net in (self.train_net, self.test_net, self.val_net):
                 if net is not None:
                     dump_net_json(net, vis)
-        chunking = self._can_chunk()
+        # streaming scan chunks: a non-cached dataset no longer falls
+        # back to one dispatch per step — the stager feeds the same
+        # _run_chunk scan path from double-buffered staged blocks
+        streaming = self.feeder_mode == "stream"
+        chunking = self._can_chunk() or streaming
         ctx = self.resilience
         step = self.start_step
         self.completed_steps = step
@@ -992,7 +1209,9 @@ class Trainer:
                 ctx.before_step(self, step)
             n = self._chunk_len(step) if chunking else 1
             self._pre_events(step)
-            if n > 1:
+            if n > 1 or (streaming and self._step_via_chunk(step)):
+                # streaming routes length-1 windows through train_chunk
+                # too: the stager's block schedule stays unbroken
                 self.train_chunk(step, n)
             else:
                 self.train_one_batch(step)
@@ -1021,6 +1240,9 @@ class Trainer:
                 continue
             for name, pipe in self._pipelines[id(net)].items():
                 out[f"{net.phase}|{name}"] = pipe.position
+        # device-feeder mode: the pipelines run ahead of the trainer by
+        # the feeder's read-ahead — checkpoint the CONSUMED positions
+        out.update(self._feeder_positions)
         return out
 
     def save(self, step: int) -> str | None:

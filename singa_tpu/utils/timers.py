@@ -48,6 +48,13 @@ class Timers:
         n = self._n.get(name, 0)
         return (self._acc.get(name, 0.0) / n * 1000.0) if n else 0.0
 
+    def share(self, name: str, *others: str) -> float:
+        """``name``'s fraction of the time accumulated across ``name`` +
+        ``others`` (the display line's input-stall percentage). 0.0 when
+        nothing has accumulated."""
+        total = sum(self.total(p) for p in (name, *others))
+        return self.total(name) / total if total > 0 else 0.0
+
     def to_string(self) -> str:
         """"train 12.3ms, data 0.8ms" — the TimerInfo display line."""
         return ", ".join(

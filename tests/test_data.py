@@ -218,7 +218,7 @@ def test_split_shard(tmp_path):
 def test_pipeline_sequential_wraparound():
     images = np.arange(5, dtype=np.float32).reshape(5, 1)
     labels = np.arange(5, dtype=np.int32)
-    p = BatchPipeline(images, labels, batchsize=3, prefetch=False)
+    p = BatchPipeline(images, labels, batchsize=3)
     x1, y1 = p.next_batch()
     x2, y2 = p.next_batch()
     np.testing.assert_array_equal(y1, [0, 1, 2])
@@ -228,44 +228,61 @@ def test_pipeline_sequential_wraparound():
 def test_pipeline_random_skip_seeded():
     images = np.zeros((100, 1), np.float32)
     labels = np.arange(100, dtype=np.int32)
-    a = BatchPipeline(images, labels, 10, random_skip=50, prefetch=False, seed=1)
-    b = BatchPipeline(images, labels, 10, random_skip=50, prefetch=False, seed=1)
+    a = BatchPipeline(images, labels, 10, random_skip=50, seed=1)
+    b = BatchPipeline(images, labels, 10, random_skip=50, seed=1)
     np.testing.assert_array_equal(a.next_batch()[1], b.next_batch()[1])
 
 
-def test_pipeline_prefetch_thread():
+def test_device_feeder_preserves_stream_order():
+    """The double-buffered feeder thread (the Prefetching protocol,
+    data/device_prefetch.py) hands batches out in exact stream order."""
+    from singa_tpu.data import DeviceFeeder
+
     images = np.arange(8, dtype=np.float32).reshape(8, 1)
     labels = np.arange(8, dtype=np.int32)
-    p = BatchPipeline(images, labels, batchsize=4, prefetch=True)
-    seen = [p.next_batch()[1] for _ in range(4)]
+    p = BatchPipeline(images, labels, batchsize=4)
+    feeder = DeviceFeeder(
+        lambda: dict(zip(("image", "label"), p.next_batch())),
+        lambda: {"train|d": p.position},
+    )
+    seen = [feeder.next()["label"] for _ in range(4)]
     np.testing.assert_array_equal(np.concatenate(seen) % 8,
                                   np.tile(np.arange(8), 2))
 
 
-def test_pipeline_position_counts_consumed_not_produced():
-    """Under prefetch the producer thread runs ahead; the checkpointed
-    position must reflect batches the trainer actually received, or a
-    resume would skip the queued-but-unconsumed ones."""
+def test_device_feeder_positions_count_consumed_not_produced():
+    """The feeder thread runs ahead of the consumer; the checkpointed
+    position must reflect batches actually received, or a resume would
+    skip the buffered-but-unconsumed ones."""
     import time
+
+    from singa_tpu.data import DeviceFeeder
 
     images = np.arange(64, dtype=np.float32).reshape(64, 1)
     labels = np.arange(64, dtype=np.int32)
-    p = BatchPipeline(images, labels, batchsize=4, prefetch=True)
+    p = BatchPipeline(images, labels, batchsize=4)
+    feeder = DeviceFeeder(
+        lambda: dict(zip(("image", "label"), p.next_batch())),
+        lambda: {"train|d": p.position},
+    )
     for _ in range(3):
-        p.next_batch()
-    time.sleep(0.2)  # let the producer fill its queue past the consumer
-    assert p.position == 12
-    assert p._pos > 12  # producer genuinely ran ahead
+        feeder.next()
+    time.sleep(0.2)  # let the feeder read ahead of the consumer
+    assert feeder.consumed_positions == {"train|d": 12}
+    assert p.position > 12  # the pipeline genuinely ran ahead
+    # reset discards the read-ahead so the stream can be re-seeked
+    feeder.reset()
+    assert feeder.consumed_positions == {}
 
 
 def test_pipeline_seek_restores_stream():
     images = np.arange(10, dtype=np.float32).reshape(10, 1)
     labels = np.arange(10, dtype=np.int32)
-    p = BatchPipeline(images, labels, batchsize=3, prefetch=False,
+    p = BatchPipeline(images, labels, batchsize=3,
                       random_skip=7, seed=0)
     p.next_batch()
     saved = p.position
-    q = BatchPipeline(images, labels, batchsize=3, prefetch=False)
+    q = BatchPipeline(images, labels, batchsize=3)
     q.seek(saved)
     np.testing.assert_array_equal(q.next_batch()[1], p.next_batch()[1])
     assert q.position == p.position

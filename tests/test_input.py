@@ -1,0 +1,376 @@
+"""Zero-stall input tests: feeders must be INVISIBLE to training.
+
+The device feeder (per-step double-buffered transfer) and the chunk
+stager (streaming lax.scan windows over staged blocks) replace the
+synchronous assemble+device_put step path — so every run through them
+must be bitwise-identical to the synchronous path: same batches, same
+wraparound stream positions, same checkpointed resume points, and fault
+injection still lands on the right step's real batch.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.config import parse_cluster_config, parse_model_config
+from singa_tpu.data.device_prefetch import (
+    ChunkStager,
+    DeviceFeeder,
+    InputFeedError,
+)
+from singa_tpu.data.loader import synthetic_arrays, write_records
+from singa_tpu.trainer import Trainer
+
+
+def _conf(shard, extra="", steps=12, batch=16):
+    return parse_model_config(f"""
+name: "input-test"
+train_steps: {steps}
+{extra}
+updater {{ base_learning_rate: 0.1 momentum: 0.9 param_type: "Param" }}
+neuralnet {{
+  layer {{ name: "data" type: "kShardData"
+          data_param {{ path: "{shard}" batchsize: {batch} }} }}
+  layer {{ name: "mnist" type: "kMnistImage" srclayers: "data"
+          mnist_param {{ norm_a: 255 norm_b: 0 }} }}
+  layer {{ name: "label" type: "kLabel" srclayers: "data" }}
+  layer {{ name: "fc" type: "kInnerProduct" srclayers: "mnist"
+          inner_product_param {{ num_output: 10 }}
+          param {{ name: "w" init_method: "kUniformSqrtFanIn" }}
+          param {{ name: "b" init_method: "kConstant" value: 0 }} }}
+  layer {{ name: "loss" type: "kSoftmaxLoss" srclayers: "fc" srclayers: "label"
+          softmaxloss_param {{ topk: 1 }} }}
+}}
+""")
+
+
+@pytest.fixture
+def shard(tmp_path):
+    path = str(tmp_path / "shard")
+    # 40 records with batch 16 -> wraparound inside every window
+    write_records(path, *synthetic_arrays(40, seed=2))
+    return path
+
+
+def _mk(shard, *, prefetch, stream_chunks=None, extra="", seed=3, cl=None):
+    return Trainer(
+        _conf(shard, extra), cl, seed=seed, log=lambda s: None,
+        prefetch=prefetch, device_cache=False, stream_chunks=stream_chunks,
+    )
+
+
+def _assert_params_equal(a, b):
+    for name in a.params:
+        np.testing.assert_array_equal(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            err_msg=f"param {name} not bitwise-identical",
+        )
+
+
+# ---------------------------------------------------------------------------
+# mode selection
+# ---------------------------------------------------------------------------
+
+
+def test_feeder_mode_selection(shard):
+    sync = _mk(shard, prefetch=False)
+    assert sync.feeder_mode == "sync"
+    stream = _mk(shard, prefetch=True)
+    assert stream.feeder_mode == "stream"
+    pf = _mk(shard, prefetch=True, stream_chunks=False)
+    assert pf.feeder_mode == "prefetch"
+    cached = Trainer(
+        _conf(shard), seed=3, log=lambda s: None,
+        prefetch=True, device_cache=True,
+    )
+    assert cached.feeder_mode == "cached"
+    # a pending fault plan needs exact per-step boundaries: streaming
+    # degrades to the per-step device feeder, never to a silent skew
+    from singa_tpu.resilience import FaultPlan, ResilienceContext
+
+    faulted = _mk(shard, prefetch=True)
+    ctx = ResilienceContext(None, FaultPlan.parse("nanloss@3"),
+                            log=lambda s: None)
+    ctx.bind(faulted)
+    try:
+        assert faulted.feeder_mode == "prefetch"
+    finally:
+        ctx.stop()
+
+
+# ---------------------------------------------------------------------------
+# device feeder (per-step prefetch)
+# ---------------------------------------------------------------------------
+
+
+def test_device_prefetch_bitwise_matches_sync(shard):
+    """Per-step training through the device feeder == the synchronous
+    path: same params (bitwise), same consumed stream positions."""
+    a = _mk(shard, prefetch=False)
+    b = _mk(shard, prefetch=True, stream_chunks=False)
+    for step in range(8):
+        a.train_one_batch(step)
+        b.train_one_batch(step)
+    _assert_params_equal(a, b)
+    # the feeder read ahead, but checkpoints see only consumed batches
+    assert a._stream_positions() == b._stream_positions()
+
+
+def test_feeder_error_surfaces_and_never_wedges():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("disk gone")
+
+    feeder = DeviceFeeder(boom, dict)
+    with pytest.raises(InputFeedError, match="disk gone"):
+        feeder.next()
+    # a retry after the error restarts production and fails loudly
+    # again — it must NEVER block on the dead thread's empty queue
+    with pytest.raises(InputFeedError, match="disk gone"):
+        feeder.next()
+    assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# chunk stager unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_stager_blocks_and_reset():
+    images = np.arange(10, dtype=np.float32)[:, None]
+    labels = np.arange(10, dtype=np.int32)
+    # a pure function of step, like the trainer's window schedule (the
+    # stager's thread evaluates it ahead of the consumer)
+    stager = ChunkStager(
+        {"d": (images, labels, 4)},
+        batches_per_step=1,
+        schedule=lambda step: {0: 2, 2: 3, 5: 2, 7: 3}.get(step, 1),
+        cursors=lambda: {"d": 6},
+        put=lambda a: a,
+    )
+    block, pos = stager.take(0, 2)
+    # 2 steps x batch 4 from record 6, wrapping at 10
+    np.testing.assert_array_equal(
+        block["d"]["image"][:, 0], [6, 7, 8, 9, 0, 1, 2, 3]
+    )
+    assert pos == {"d": 4}
+    block, pos = stager.take(2, 3)
+    np.testing.assert_array_equal(
+        block["d"]["image"][:, 0],
+        [4, 5, 6, 7, 8, 9, 0, 1, 2, 3, 4, 5],
+    )
+    assert pos == {"d": 6}
+    # a schedule mismatch is loud, not silently wrong records
+    with pytest.raises(InputFeedError, match="schedule"):
+        stager.take(99, 1)
+    # reset discards read-ahead; the next take restarts from cursors()
+    stager.reset()
+    block, pos = stager.take(0, 2)
+    np.testing.assert_array_equal(
+        block["d"]["image"][:, 0], [6, 7, 8, 9, 0, 1, 2, 3]
+    )
+
+
+# ---------------------------------------------------------------------------
+# streaming scan chunks (the tentpole path)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_chunk_run_bitwise_matches_stepwise(shard):
+    """A full streaming run() (scan chunks over staged blocks) is
+    bitwise-identical to the per-step synchronous run()."""
+    a = _mk(shard, prefetch=False, seed=1)
+    b = _mk(shard, prefetch=True, seed=1)
+    assert not b._can_chunk()  # not device-cached ...
+    assert b.feeder_mode == "stream"  # ... yet it chunks anyway
+    chunks = []
+    orig = Trainer.train_chunk
+
+    def spy(self, step0, nsteps):
+        chunks.append((step0, nsteps))
+        return orig(self, step0, nsteps)
+
+    b.train_chunk = spy.__get__(b)
+    a.run()
+    b.run()
+    assert chunks, "streaming chunk path never engaged"
+    assert sum(n for _, n in chunks) == 12
+    _assert_params_equal(a, b)
+    assert a._stream_positions() == b._stream_positions()
+
+
+def test_stream_chunk_respects_cadences(shard):
+    """Cadence events still fire at their exact steps (windows slice at
+    display/test boundaries, length-1 windows stay on the stager's
+    schedule), and the result stays bitwise-identical."""
+    extra = "test_steps: 1\ntest_frequency: 5\ndisplay_frequency: 4\n"
+    logs_a, logs_b = [], []
+    a = Trainer(_conf(shard, extra), seed=0, log=logs_a.append,
+                prefetch=False, device_cache=False)
+    b = Trainer(_conf(shard, extra), seed=0, log=logs_b.append,
+                prefetch=True, device_cache=False)
+    a.run()
+    b.run()
+    _assert_params_equal(a, b)
+    for logs in (logs_a, logs_b):
+        assert len([l for l in logs if "train" in l]) == 3  # 0, 4, 8
+        assert len([l for l in logs if "test" in l]) == 3  # 0, 5, 10
+    # the display line carries the input-stall readout
+    assert any("data" in l and "%" in l for l in logs_b if "train" in l)
+
+
+def test_stream_resume_is_exact(shard, tmp_path):
+    """Streaming run -> mid-run checkpoint -> fresh streaming trainer
+    resumes it: stream positions restore exactly, final params match the
+    uninterrupted run bitwise."""
+    cl1 = parse_cluster_config(f'nworkers: 1 workspace: "{tmp_path}/ws1"')
+    a = _mk(shard, prefetch=True, extra="checkpoint_frequency: 5",
+            seed=2, cl=cl1)
+    assert a.feeder_mode == "stream"
+    a.run()
+    cfg = _conf(shard, "checkpoint_frequency: 5")
+    cfg.checkpoint = f"{tmp_path}/ws1/checkpoints/step_5.npz"
+    cl2 = parse_cluster_config(f'nworkers: 1 workspace: "{tmp_path}/ws2"')
+    b = Trainer(cfg, cl2, seed=2, log=lambda s: None,
+                prefetch=True, device_cache=False)
+    assert b.start_step == 5
+    # the resumed stream starts where the checkpoint's consumed
+    # position says, not at the shard start
+    assert b._stream_positions() == {"kTrain|data": (5 * 16) % 40}
+    b.run()
+    _assert_params_equal(a, b)
+    assert a._stream_positions() == b._stream_positions()
+
+
+@pytest.mark.slow
+def test_stream_rollback_replays_exactly(shard, tmp_path):
+    """rollback_to under streaming discards the stager's read-ahead,
+    re-seeks the stream, and replays to the same final params."""
+    cl = parse_cluster_config(f'nworkers: 1 workspace: "{tmp_path}/ws"')
+    tr = _mk(shard, prefetch=True, extra="checkpoint_frequency: 5", cl=cl)
+    tr.run()
+    want = {n: np.asarray(v) for n, v in tr.params.items()}
+    assert tr.rollback_to(f"{tmp_path}/ws/checkpoints/step_5.npz") == 5
+    tr.run()
+    for name in want:
+        np.testing.assert_array_equal(
+            want[name], np.asarray(tr.params[name]), err_msg=name
+        )
+
+
+# ---------------------------------------------------------------------------
+# resilience seams through the feeders
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_crash_resume_with_prefetch_feeder(tmp_path):
+    """crash@7 supervised auto-resume with prefetch on: the fault plan
+    forces the per-step device feeder, the restored run continues the
+    stream exactly (checkpointed positions ignore feeder read-ahead),
+    and final params are bitwise-identical to an uninterrupted run."""
+    from test_resilience import make_job
+
+    from singa_tpu.resilience import EXIT_OK, supervisor
+    from singa_tpu.trainer import load_checkpoint
+
+    cfg_a, cl_a, _ = make_job(tmp_path / "a")
+    assert supervisor.run(
+        cfg_a, cl_a, seed=3, log=lambda s: None,
+        prefetch=True, device_cache=False,
+    ) == EXIT_OK
+    logs = []
+    cfg_b, cl_b, _ = make_job(tmp_path / "b")
+    rc = supervisor.run(
+        cfg_b, cl_b, seed=3, faults="crash@7", log=logs.append,
+        prefetch=True, device_cache=False,
+    )
+    assert rc == EXIT_OK
+    assert any("resumed from" in l and "step_5" in l for l in logs)
+
+    def final(cl):
+        from singa_tpu.trainer.checkpoint import load_stream_positions
+
+        path = os.path.join(cl.workspace, "checkpoints", "step_12.npz")
+        _, params, _, _ = load_checkpoint(path)
+        return params, load_stream_positions(path)
+
+    pa, sa = final(cl_a)
+    pb, sb = final(cl_b)
+    assert sa == sb and sa  # stream positions restored exactly
+    assert set(pa) == set(pb)
+    for name in pa:
+        np.testing.assert_array_equal(pa[name], pb[name], err_msg=name)
+
+
+@pytest.mark.slow
+def test_nanloss_lands_on_right_step_through_feeder(tmp_path):
+    """nanloss@5 with the device feeder active poisons exactly step 5's
+    batch (the guard counts ONE bad step) and the run is bitwise-equal
+    to the same fault on the synchronous path."""
+    from test_resilience import make_job
+
+    from singa_tpu.resilience import FaultPlan, ResilienceContext
+
+    def run(root, prefetch):
+        cfg, cl, _ = make_job(
+            root, train_steps=10, checkpoint_frequency=0,
+            resilience="guard_policy: kSkip",
+        )
+        ctx = ResilienceContext(
+            cfg.resilience, FaultPlan.parse("nanloss@5"), log=lambda s: None
+        )
+        tr = Trainer(cfg, cl, seed=3, log=lambda s: None,
+                     prefetch=prefetch, device_cache=False)
+        ctx.bind(tr)
+        try:
+            tr.run()
+        finally:
+            ctx.stop()
+        return tr
+
+    a = run(tmp_path / "a", False)
+    b = run(tmp_path / "b", True)
+    assert b.guard_counters()["bad_steps"] == 1
+    _assert_params_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# replica engine rides the same feeder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_replica_stream_matches_stepwise(shard):
+    """The replica engine's fused sync windows over staged streaming
+    blocks == its per-step synchronous path, bitwise — warmup runs
+    per-step, then whole sync windows stream through the stager."""
+    from singa_tpu.parallel.mesh import build_mesh
+    from singa_tpu.trainer import ReplicaTrainer
+
+    def mk(prefetch):
+        cfg = _conf(shard, steps=24)
+        cfg.updater.param_type = "Elastic"
+        cfg.updater.moving_rate = 0.3
+        cfg.updater.sync_frequency = 2
+        cfg.updater.warmup_steps = 4
+        return ReplicaTrainer(
+            cfg, mesh=build_mesh(4, 1), seed=0, log=lambda s: None,
+            prefetch=prefetch, device_cache=False,
+        )
+
+    a, b = mk(False), mk(True)
+    assert a.feeder_mode == "sync" and b.feeder_mode == "stream"
+    a.run()
+    b.run()
+    _assert_params_equal(a, b)
+    for name in a.center:
+        np.testing.assert_array_equal(
+            np.asarray(a.center[name]), np.asarray(b.center[name]),
+            err_msg=f"center {name}",
+        )
+    assert a._stream_positions() == b._stream_positions()

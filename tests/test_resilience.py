@@ -236,6 +236,48 @@ def test_gc_stale_shards(tmp_path):
     ]
 
 
+def test_retention_validation_cache(tmp_path, monkeypatch):
+    """A second save's retention pass CRC-walks only the NEW checkpoint:
+    already-validated saves are remembered by (mtime, size) fingerprint
+    — and any content change (a torn file) forces a real re-check."""
+    folder = str(tmp_path)
+    retention.validation_cache_clear()
+    walked = []
+    real = retention._npz_valid
+    monkeypatch.setattr(
+        retention, "_npz_valid", lambda p: walked.append(p) or real(p)
+    )
+    # save 1: validate + mark + retention (the checkpoint_written flow)
+    a = _fake_ckpt(folder, 5)
+    assert retention.validate_checkpoint(a)
+    retention.mark_latest(folder, a)
+    retention.apply_retention(folder, 3)
+    assert walked.count(a) == 1  # retention's re-check hit the cache
+    # save 2 validates only itself — the step-5 walk is never repeated
+    walked.clear()
+    b = _fake_ckpt(folder, 10)
+    assert retention.validate_checkpoint(b)
+    retention.mark_latest(folder, b)
+    retention.apply_retention(folder, 3)
+    assert walked == [b]
+    # resolve_latest on restore also rides the cache
+    walked.clear()
+    assert retention.resolve_latest(folder) == b
+    assert walked == []
+    # tearing a cached checkpoint invalidates its fingerprint: the next
+    # validation is a REAL walk and fails
+    with open(b, "r+b") as f:
+        f.truncate(os.path.getsize(b) // 2)
+    assert not retention.validate_checkpoint(b)
+    assert walked == [b]
+    # a deleted checkpoint's cache entry goes with it
+    retention.mark_latest(folder, a)
+    _fake_ckpt(folder, 15)
+    retention.apply_retention(folder, 1)
+    assert not os.path.exists(b)
+    assert b not in retention._VALIDATED
+
+
 # ---------------------------------------------------------------------------
 # supervisor end-to-end: the acceptance scenarios
 # ---------------------------------------------------------------------------
