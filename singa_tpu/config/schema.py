@@ -639,6 +639,23 @@ class ResilienceConfig(Message):
         "watchdog_timeout": Field("float", 0.0),
         # write a final checkpoint when draining on SIGTERM/SIGINT
         "preemption_checkpoint": Field("bool", True),
+        # --- cluster coordination (resilience/coord.py) ---
+        # fold every host's preemption flag into a cross-host OR at
+        # step/chunk boundaries so ANY host's SIGTERM drains EVERY host
+        # at the SAME step (all ranks checkpoint + exit 75 together);
+        # no-op on single-process jobs
+        "coordinate_preemption": Field("bool", True),
+        # peer-liveness watchdog: each rank touches a heartbeat file
+        # while its process lives; a peer file stale past this many
+        # seconds while OUR step is stalled means the peer died
+        # mid-collective -> loud resumable exit (75) instead of a
+        # silent forever-hang. 0 = disabled.
+        "heartbeat_timeout_s": Field("float", 0.0),
+        # two-phase sharded-save commit: process 0 promotes LATEST only
+        # after every rank's CRC'd commit_k marker lands and verifies;
+        # past this deadline the save is judged torn (LATEST keeps the
+        # previous complete checkpoint)
+        "commit_timeout_s": Field("float", 60.0),
     }
 
 

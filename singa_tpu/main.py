@@ -75,9 +75,23 @@ def main(argv: list[str] | None = None) -> int:
     # drain (exit 75 = resumable), divergence guard, and the watchdog
     from .resilience import supervisor
 
-    return supervisor.run(
+    rc = supervisor.run(
         model_cfg, cluster_cfg, seed=args.seed, faults=args.faults
     )
+    from .resilience.coord import process_count
+
+    if rc != 0 and process_count() > 1:
+        # a non-zero exit in a multi-process job leaves peers
+        # mid-collective (a crash) or exiting in parallel (a
+        # coordinated drain). jax's atexit distributed shutdown would
+        # block on them — or, when the coordination service dies first,
+        # abort THIS process with SIGABRT, destroying the exit code the
+        # launcher keys its restart decision on. Flush and leave with
+        # the real status instead.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    return rc
 
 
 if __name__ == "__main__":

@@ -95,9 +95,31 @@ def init_distributed(
         raise ValueError(
             f"procs_id {procs_id!r} out of range for {len(hosts)} hosts"
         )
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address(hosts, port),
         num_processes=len(hosts),
         process_id=procs_id,
     )
     return True
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process jobs on the CPU backend need jax's gloo collectives
+    implementation — the default ('none') fails every cross-process
+    computation with "Multiprocess computations aren't implemented on
+    the CPU backend", which would take the whole coordination plane
+    (resilience/coord.py preemption barriers, multihost_utils
+    broadcasts) down with it. Must run BEFORE the backend initializes;
+    a no-op on jax builds without the option (TPU runtimes ignore it)."""
+    import jax
+
+    platforms = os.environ.get("JAX_PLATFORMS", "") or str(
+        getattr(jax.config, "jax_platforms", "") or ""
+    )
+    if "cpu" not in platforms:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass

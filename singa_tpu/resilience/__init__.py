@@ -20,9 +20,17 @@ obligation moves into this trainer-side resilience layer:
                   snapshot at the step boundary + a double-buffered
                   background writer publishing through retention's
                   atomic LATEST (``async_checkpoint: true``)
+  coord.py        the cross-process coordination plane: coordinated
+                  preemption drain (any host's SIGTERM -> every host
+                  drains at the SAME step and exits 75 together) and
+                  the two-phase commit markers for sharded saves
   watchdog.py     step-wall-clock watchdog (hung-collective detection)
-  faults.py       the deterministic fault plan (``crash@7,...``) that
-                  lets tests PROVE end-to-end recovery
+                  + per-rank heartbeat files with a peer-liveness
+                  deadline — a dead peer turns a forever-hung
+                  collective into a loud resumable exit
+  faults.py       the deterministic fault plan (``crash@7,...``, with
+                  an optional ``:rank=K`` target) that lets tests
+                  PROVE end-to-end recovery
   context.py      ResilienceContext — what the trainer's step-boundary
                   seams actually call
 
@@ -33,6 +41,7 @@ supervisor. ``supervisor`` itself is imported lazily (it pulls in the
 trainer package) — use ``from singa_tpu.resilience import supervisor``.
 """
 
+from . import coord  # noqa: F401
 from .async_ckpt import AsyncCheckpointer, AsyncWriteError  # noqa: F401
 from .context import ResilienceContext  # noqa: F401
 from .faults import (  # noqa: F401

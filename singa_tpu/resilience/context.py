@@ -27,7 +27,7 @@ import time
 import jax.numpy as jnp
 
 from ..config.schema import ResilienceConfig
-from . import retention
+from . import coord, retention
 from .async_ckpt import AsyncCheckpointer
 from .faults import FaultPlan, InjectedCrash, tear_file
 from .guard import GUARD_CONSEC, GUARD_LR, GuardGaveUp
@@ -66,6 +66,8 @@ class ResilienceContext:
         #: got past the step that tripped the previous one
         self._stuck_rollbacks = 0
         self._rollback_high_step = -1
+        #: process count, refreshed at bind (jax is initialized by then)
+        self._nprocs = 1
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -78,12 +80,44 @@ class ResilienceContext:
         run stays deterministic over chunk throughput)."""
         return bool(self.plan)
 
+    @property
+    def coordinated_exit(self) -> bool:
+        """True when this rank's drain is guaranteed to be cluster-wide
+        (single process, or the coordinated drain is on) — i.e. when a
+        drained exit may publish the 'deliberate exit' sentinel without
+        stranding peers in a collective."""
+        return self._nprocs <= 1 or bool(self.cfg.coordinate_preemption)
+
     def bind(self, trainer) -> None:
         """Attach to a (possibly restarted) trainer instance."""
         trainer.resilience = self
         self.ckpt_dir = trainer._checkpoint_dir()
+        self._nprocs = coord.process_count()
+        # peer-liveness heartbeats (watchdog.py): each rank's watchdog
+        # thread touches <workspace>/heartbeats/rank_k.hb while the
+        # process lives; a peer file stale past heartbeat_timeout_s
+        # while OUR step is stalled turns a forever-hung collective
+        # into a loud resumable exit
+        if (
+            self.cfg.heartbeat_timeout_s > 0
+            and self._nprocs > 1
+            and trainer.cluster is not None
+            and trainer.cluster.workspace
+        ):
+            self.watchdog.enable_heartbeats(
+                os.path.join(trainer.cluster.workspace, "heartbeats"),
+                rank=coord.process_index(),
+                nprocs=self._nprocs,
+                peer_timeout=self.cfg.heartbeat_timeout_s,
+            )
         self.watchdog.beat(trainer.start_step)
         self.watchdog.start()
+
+    def mark_done(self) -> None:
+        """A deliberate exit (training complete, or a coordinated
+        drain): publish the done sentinel so peers' liveness watchdogs
+        never read our frozen heartbeat as a death."""
+        self.watchdog.mark_done()
 
     def stop(self) -> None:
         self.watchdog.stop()
@@ -116,7 +150,18 @@ class ResilienceContext:
         if spec is not None:
             self.log(f"FAULT: crash@{step} — raising InjectedCrash")
             raise InjectedCrash(f"injected crash@{step}")
-        if self.preemption.requested:
+        requested = self.preemption.requested
+        if self.cfg.coordinate_preemption and self._nprocs > 1:
+            # coordinated drain (resilience/coord.py): fold every
+            # host's flag into a cross-host OR at this boundary — one
+            # tiny allgather riding the loop's existing sync cadence —
+            # so any host's SIGTERM drains EVERY host at THIS step
+            requested = coord.preemption_barrier(requested)
+            if requested and not self.preemption.requested:
+                self.preemption.trigger(
+                    "coordinated drain (a peer host was preempted)"
+                )
+        if requested:
             self._drain(trainer, step)
 
     def _drain(self, trainer, step: int) -> None:
@@ -213,8 +258,11 @@ class ResilienceContext:
         out = {}
         for name, feed in batch.items():
             if "__idx__" in feed:
+                # idx may be multi-dim — the replica engine gathers a
+                # (replicas, batch) grid; the poisoned feed keeps every
+                # leading index axis so the vmapped step maps it as-is
                 idx = feed["__idx__"]
-                shape = (int(idx.shape[0]),) + tuple(feed["image"].shape[1:])
+                shape = tuple(idx.shape) + tuple(feed["image"].shape[1:])
                 labels = jnp.take(feed["label"], idx, axis=0)
             else:
                 shape = tuple(feed["image"].shape)
@@ -240,17 +288,20 @@ class ResilienceContext:
             )
         # validation, LATEST, and retention are process 0's job alone:
         # every process racing rmtree/marker writes on the same dir
-        # would be chaos. (Real cross-process save barriers are a
-        # ROADMAP item; until then process 0 polls briefly for the
-        # peers' shard files before judging a sharded save torn.)
-        import jax
-
-        if jax.process_index() != 0:
+        # would be chaos. For sharded saves, promotion is the second
+        # phase of the commit protocol (resilience/coord.py): wait for
+        # every rank's CRC'd commit_k marker, verify each against its
+        # shard, and on a missed deadline judge the save TORN — never
+        # early, never with whatever shards happen to exist.
+        if coord.process_index() != 0:
             return
+        committed = True
         if os.path.isdir(path):
-            self._await_peer_shards(path)
+            committed = coord.await_commits(
+                path, timeout=self.cfg.commit_timeout_s, log=self.log
+            )
         folder = os.path.dirname(path)
-        if retention.validate_checkpoint(path):
+        if committed and retention.validate_checkpoint(path):
             retention.mark_latest(folder, path)
         else:
             self.log(
@@ -260,26 +311,4 @@ class ResilienceContext:
         if self.cfg.keep_last > 0:
             for gone in retention.apply_retention(folder, self.cfg.keep_last):
                 self.log(f"retention: removed {gone}")
-
-    @staticmethod
-    def _await_peer_shards(path: str, timeout: float = 10.0) -> None:
-        """Bounded wait for every manifest-promised proc_k.npz: peer
-        processes write their shards concurrently with process 0's
-        manifest, so 'missing' usually means 'still in flight', not
-        'torn'. Validation after the wait still catches real tears."""
-        import json
-
-        try:
-            with open(os.path.join(path, "manifest.json")) as f:
-                nprocs = int(json.load(f).get("nprocs", 1))
-        except (OSError, ValueError):
-            return
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if all(
-                os.path.exists(os.path.join(path, f"proc_{k}.npz"))
-                for k in range(nprocs)
-            ):
-                return
-            time.sleep(0.05)
 

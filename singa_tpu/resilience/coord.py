@@ -1,0 +1,206 @@
+"""Cross-process coordination plane: the cluster half of resilience.
+
+The reference survived process loss because the parameter server was
+the durable truth and workers handshook through Router PING/PONG
+barriers (src/utils/router.cc:16-86). singa-tpu has no server tier, so
+the coordination obligations move here, shaped by TPU-pod preemption
+semantics (maintenance SIGTERMs arrive per-host; a collective that
+loses any peer hangs forever instead of crashing):
+
+  preemption_barrier   fold each host's local preemption flag into a
+      cross-host OR — one tiny allgather at step/chunk-boundary cadence
+      (the loop's existing sync points; never inside a step) so ANY
+      host's SIGTERM makes EVERY host drain at the SAME step boundary,
+      write its shard of the drain checkpoint, and exit resumable (75)
+      together. The launcher then restarts all ranks from one
+      consistent step. Chandy-Lamport in miniature: the OR-ed flag is
+      the marker, the step boundary is the consistent cut.
+
+  commit markers       the two-phase commit for sharded async saves.
+      Phase 1: every process publishes its ``proc_k.npz`` shard and
+      then a CRC'd ``commit_k.json`` marker (atomic tmp+rename, so a
+      marker is either absent or complete). Phase 2: process 0 promotes
+      ``LATEST`` only after ``await_commits`` observes every marker and
+      verifies each against its shard's bytes. A missed deadline
+      degrades to an EXPLICIT "torn — keep the previous LATEST"
+      verdict, never to judging the save early with whatever shards
+      happen to exist (the bug the old filesystem poll had).
+      ``retention._sharded_valid`` checks the same markers on the
+      restore side, so a half-committed save is never resumable.
+
+No imports from the trainer package, and retention must be able to
+import this module (not the other way round).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+
+#: manifest field value declaring "this save carries commit markers"
+COMMIT_VERSION = 2
+#: format tag inside each marker file
+COMMIT_FORMAT = "singa-tpu-commit-v2"
+
+
+def process_count() -> int:
+    """Lazy jax.process_count() — 1 when jax is unavailable/uninitialized."""
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# coordinated preemption drain
+# ---------------------------------------------------------------------------
+
+
+def preemption_barrier(requested: bool) -> bool:
+    """Cross-host OR of this host's preemption flag.
+
+    Called at step/chunk boundaries (every rank calls it at the SAME
+    boundaries — the cadence loop is deterministic), so the allgather
+    doubles as the consistent cut: when it returns True on one rank it
+    returns True on all of them, and every rank drains at this exact
+    step. Single-process jobs short-circuit to the local flag."""
+    if process_count() <= 1:
+        return bool(requested)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray(bool(requested), np.int32)
+    )
+    return bool(np.asarray(flags).any())
+
+
+# ---------------------------------------------------------------------------
+# two-phase sharded-save commit
+# ---------------------------------------------------------------------------
+
+
+def commit_marker_path(path: str, proc: int) -> str:
+    """``commit_k.json`` inside sharded checkpoint dir ``path``."""
+    return os.path.join(path, f"commit_{proc}.json")
+
+
+def shard_digest(shard_file: str) -> dict:
+    """{"size", "crc32"} over the shard file's full byte stream."""
+    crc = 0
+    size = 0
+    with open(shard_file, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return {"size": size, "crc32": crc & 0xFFFFFFFF}
+
+
+def write_commit(path: str, proc: int) -> str:
+    """Publish process ``proc``'s commit marker for the shard it just
+    wrote (phase 1 of the two-phase commit). Atomic tmp+rename: a
+    marker can be absent or complete, never torn-but-parseable."""
+    marker = {
+        "format": COMMIT_FORMAT,
+        "proc": int(proc),
+        **shard_digest(os.path.join(path, f"proc_{proc}.npz")),
+    }
+    mpath = commit_marker_path(path, proc)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(marker, f)
+    os.replace(tmp, mpath)
+    return mpath
+
+
+def commit_ok(path: str, proc: int) -> bool:
+    """True iff process ``proc``'s commit marker exists, parses, and
+    matches its shard's bytes (size + CRC32). Any tear — of the marker
+    OR of the shard after the marker was written (the corrupt_ckpt /
+    async_torn_write faults) — fails here."""
+    try:
+        with open(commit_marker_path(path, proc), encoding="utf-8") as f:
+            marker = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if marker.get("format") != COMMIT_FORMAT:
+        return False
+    if int(marker.get("proc", -1)) != int(proc):
+        return False
+    try:
+        digest = shard_digest(os.path.join(path, f"proc_{proc}.npz"))
+    except OSError:
+        return False
+    try:
+        return (
+            int(marker["size"]) == digest["size"]
+            and int(marker["crc32"]) == digest["crc32"]
+        )
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+def await_commits(
+    path: str, timeout: float = 60.0, log=print, poll: float = 0.05
+) -> bool:
+    """Phase 2, run by process 0 before promoting ``LATEST``: wait for
+    every manifest-promised commit marker to EXIST. Byte verification
+    (marker CRC vs shard) is deliberately NOT done here — it happens
+    exactly once, in ``retention.validate_checkpoint``, which the
+    caller runs next; verifying here too would read every shard's full
+    bytes twice per save on process 0's promotion path.
+
+    A marker is atomic (tmp+rename after its shard), so existence is
+    the only thing that can legitimately lag — bounded by ``timeout``.
+    Past the deadline the save is judged torn — explicitly, loudly —
+    and LATEST keeps naming the previous complete checkpoint. Never
+    judges early with whatever shards happen to exist."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        log(
+            f"COMMIT: {path} has no readable manifest — "
+            "treating the save as torn"
+        )
+        return False
+    if manifest.get("commit") != COMMIT_VERSION:
+        # pre-commit-protocol dir: nothing to await; retention's CRC
+        # walk remains the only defense
+        return True
+    nprocs = int(manifest.get("nprocs", 1))
+    deadline = time.monotonic() + max(0.0, timeout)
+    while True:
+        missing = [
+            k
+            for k in range(nprocs)
+            if not os.path.exists(commit_marker_path(path, k))
+        ]
+        if not missing:
+            break
+        if time.monotonic() >= deadline:
+            log(
+                f"COMMIT: deadline ({timeout:g}s) expired waiting for "
+                f"commit marker(s) {missing} in {path} — judging the "
+                "save TORN; LATEST keeps the previous complete "
+                "checkpoint"
+            )
+            return False
+        time.sleep(poll)
+    return True

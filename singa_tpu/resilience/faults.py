@@ -1,8 +1,8 @@
 """Deterministic fault injection: the proof harness for recovery paths.
 
 A fault plan is a comma-separated list of ``kind@at`` terms (optionally
-``kind@at=value``), parsed from the ``-faults`` CLI flag or the
-``SINGA_TPU_FAULTS`` env var:
+``kind@at=value``, optionally rank-targeted with ``:rank=K``), parsed
+from the ``-faults`` CLI flag or the ``SINGA_TPU_FAULTS`` env var:
 
   crash@7          raise InjectedCrash at the step-7 boundary (before the
                    step runs) — exercises supervisor auto-resume
@@ -22,6 +22,22 @@ A fault plan is a comma-separated list of ``kind@at`` terms (optionally
                    (resilience/async_ckpt.py): LATEST must keep naming
                    the previous complete save
 
+A ``:rank=K`` qualifier scopes a term to ONE process of a multi-process
+job — ``sigterm@12:rank=0`` preempts only rank 0 (its peers learn of it
+through the coordinated drain, resilience/coord.py), ``crash@7:rank=1``
+kills only rank 1 (its peers' liveness watchdog turns the resulting
+hung collective into a resumable exit). Unqualified terms fire on every
+rank, which is what single-process drills always did. A rank-qualified
+term that does not match this process is left UNFIRED — it neither
+fires nor burns its once-only budget on the wrong rank.
+
+Multi-process jobs must receive the SAME plan string on EVERY rank —
+that is the whole point of the rank qualifier. A plan's presence forces
+per-step boundaries (context.per_step), so a plan passed to one rank
+only would desync that rank's step/chunk cadence — and with it every
+collective, including the coordinated-drain barrier — from its
+plan-less peers.
+
 Every fault fires exactly once per plan object. The supervisor owns ONE
 plan across all restart attempts, so ``crash@7`` does not re-fire after
 the auto-resumed run passes step 7 again — which is what makes
@@ -35,6 +51,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
+
+
+# this process's rank, resolved lazily at fire time (coord's helper
+# only imports jax inside the call) so plan PARSING never imports jax
+from .coord import process_index as _process_index
 
 
 class FaultPlanError(ValueError):
@@ -76,16 +97,19 @@ def tear_file(path: str) -> None:
 
 @dataclasses.dataclass
 class FaultSpec:
-    """One ``kind@at[=value]`` term; ``fired`` flips on injection."""
+    """One ``kind@at[=value][:rank=K]`` term; ``fired`` flips on
+    injection. ``rank=None`` means every process."""
 
     kind: str
     at: int
     value: float | None = None
+    rank: int | None = None
     fired: bool = False
 
     def __str__(self) -> str:
         v = "" if self.value is None else f"={self.value:g}"
-        return f"{self.kind}@{self.at}{v}"
+        r = "" if self.rank is None else f":rank={self.rank}"
+        return f"{self.kind}@{self.at}{v}{r}"
 
 
 class FaultPlan:
@@ -101,7 +125,29 @@ class FaultPlan:
             term = term.strip()
             if not term:
                 continue
-            head, sep, val = term.partition("=")
+            # the rank qualifier splits off first: values are plain
+            # floats, so the first ':' can only start ":rank=K"
+            body, sep_r, qual = term.partition(":")
+            rank = None
+            if sep_r:
+                qkey, qsep, qval = qual.partition("=")
+                if qkey != "rank" or not qsep:
+                    raise FaultPlanError(
+                        f"fault term {term!r}: unknown qualifier "
+                        f"{qual!r} (expected ':rank=K')"
+                    )
+                try:
+                    rank = int(qval)
+                except ValueError:
+                    raise FaultPlanError(
+                        f"fault term {term!r}: rank {qval!r} is not an "
+                        "integer"
+                    ) from None
+                if rank < 0:
+                    raise FaultPlanError(
+                        f"fault term {term!r}: negative rank"
+                    )
+            head, sep, val = body.partition("=")
             kind, sep2, at = head.partition("@")
             if not sep2:
                 raise FaultPlanError(
@@ -128,18 +174,25 @@ class FaultPlan:
                     raise FaultPlanError(
                         f"fault term {term!r}: value {val!r} is not a number"
                     ) from None
-            specs.append(FaultSpec(kind, at_n, value))
+            specs.append(FaultSpec(kind, at_n, value, rank))
         return cls(specs)
 
     def __bool__(self) -> bool:
         return bool(self.specs)
 
     def fire(self, kind: str, at: int) -> FaultSpec | None:
-        """The unfired ``kind@at`` spec, marked fired — or None."""
+        """The unfired ``kind@at`` spec, marked fired — or None.
+
+        Rank-qualified specs only fire on their target process; on any
+        other rank they stay unfired (the qualifier scopes the fault,
+        it must not be consumed by the ranks it skips)."""
         for spec in self.specs:
-            if spec.kind == kind and spec.at == at and not spec.fired:
-                spec.fired = True
-                return spec
+            if spec.kind != kind or spec.at != at or spec.fired:
+                continue
+            if spec.rank is not None and spec.rank != _process_index():
+                continue
+            spec.fired = True
+            return spec
         return None
 
     def unfired(self) -> list[FaultSpec]:

@@ -1,10 +1,10 @@
 """Divergence guard: on-device bad-step detection, host-side policy.
 
-The detection half lives INSIDE the jitted train step (trainer.py
-``_train_step_fn``): one fused finiteness verdict over the step's loss
-and global grad-norm, folded into the step's own outputs — the guard
-counters ride the buffer pytree the step already threads, so a guarded
-run does exactly as many host syncs as an unguarded one (none per step;
+The detection half lives INSIDE the jitted train step (each engine's
+``_step_core``): one fused finiteness verdict over the step's own
+health signals, folded into the step's outputs — the guard counters
+ride the buffer pytree the step already threads, so a guarded run does
+exactly as many host syncs as an unguarded one (none per step;
 self-lint's JAX-hazard pass stays clean).
 
 Policies (ResilienceConfig.guard_policy):
@@ -22,8 +22,17 @@ Policies (ResilienceConfig.guard_policy):
 
 The counters live in the buffers dict under reserved dunder keys, so
 they checkpoint/restore with the rest of training state for free.
-Supported on the backprop engine (the base Trainer step); the CD and
-replica engines override the step body and reject guard configs loudly.
+
+All three engines share ONE wrapper (``guarded_step``): each engine
+implements a ``_step_core`` that computes its update plus its own
+finiteness verdict (base: loss + global grad-norm; replica: every
+replica's loss + grad-norm — any bad replica voids the whole step, so
+the shared counters and a rollback stay consistent across replicas;
+CD: the CD grads + per-RBM metrics), scales its gradients by the
+accumulated LR backoff, and the wrapper applies the verdict to
+params/state/buffers and threads the counters — identically for every
+engine, including the replica engine's ``.server`` sidecar state
+(rollback restores it through the engine's own resume path).
 """
 
 from __future__ import annotations
@@ -107,3 +116,42 @@ def step_guard_buffers(ok, buffers) -> dict[str, jnp.ndarray]:
         GUARD_BAD: (buffers[GUARD_BAD] + bad).astype(jnp.int32),
         GUARD_LR: buffers[GUARD_LR],
     }
+
+
+def split_guard_buffers(buffers) -> tuple[dict, dict]:
+    """-> (layer buffers, guard counters) — engines' step cores see
+    only the layer half; the wrapper owns the counters."""
+    layer = {k: v for k, v in buffers.items() if k not in GUARD_KEYS}
+    g = {k: buffers[k] for k in GUARD_KEYS if k in buffers}
+    return layer, g
+
+
+def guarded_step(core, params, state, buffers, step, batch, rng):
+    """The ONE engine-independent guard wrapper (runs inside the jitted
+    step, zero host syncs).
+
+    ``core(params, state, layer_buffers, step, batch, rng, lr_scale)``
+    -> ``(new_params, new_state, new_layer_buffers, metrics, ok)``:
+    the engine's own update with ``lr_scale`` folded into its grads and
+    ``ok`` its scalar finiteness verdict. The wrapper drops a bad
+    step's updates on device (``where(ok, new, old)`` over every tree),
+    zeroes its metrics (a NaN must not pollute the display window's
+    running sums), and threads the counters through the buffer pytree.
+    """
+    lr_scale = buffers[GUARD_LR]
+    layer_bufs, _ = split_guard_buffers(buffers)
+    new_p, new_s, new_b, metrics, ok = core(
+        params, state, layer_bufs, step, batch, rng, lr_scale
+    )
+    out_params = apply_verdict(ok, new_p, params)
+    out_state = apply_verdict(ok, new_s, state)
+    # only keys the core returned (forward may thread a subset); old
+    # values come from the pre-step buffers
+    out_buffers = dict(
+        apply_verdict(ok, new_b, {k: buffers[k] for k in new_b})
+    )
+    out_buffers.update(step_guard_buffers(ok, buffers))
+    metrics = jax.tree.map(
+        lambda m: jnp.where(ok, m, jnp.zeros_like(m)), metrics
+    )
+    return out_params, out_state, out_buffers, metrics

@@ -17,7 +17,11 @@ them to trust and which to keep:
     npz files must be intact zip archives holding the step key; sharded
     dirs must hold a parseable manifest plus every ``proc_k`` shard the
     manifest promises (CRC-checked) — a torn multi-process save or a
-    stale dir from a differently-sized job fails here, loudly.
+    stale dir from a differently-sized job fails here, loudly. Sharded
+    dirs written under the two-phase commit protocol (manifest field
+    ``commit``, resilience/coord.py) additionally need every per-proc
+    ``commit_k.json`` marker to match its shard's bytes, so a
+    half-committed save is never resumable.
   - ``apply_retention`` garbage-collects all but the newest N complete
     checkpoints (never the one LATEST names).
   - ``gc_stale_shards`` removes ``proc_k.npz`` files a previously larger
@@ -36,10 +40,13 @@ import re
 import shutil
 import zipfile
 
+from . import coord
+
 LATEST_MARKER = "LATEST"
 
 _STEP_RE = re.compile(r"^step_(\d+)\.(npz|ckpt)$")
 _PROC_RE = re.compile(r"^proc_(\d+)\.npz$")
+_COMMIT_RE = re.compile(r"^commit_(\d+)\.json$")
 
 
 def checkpoint_step(path: str) -> int | None:
@@ -62,7 +69,11 @@ def _npz_valid(path: str) -> bool:
 
 
 def _sharded_valid(path: str) -> bool:
-    """Manifest parses and every promised proc shard is an intact zip."""
+    """Manifest parses, every promised proc shard is an intact zip, and
+    — for saves written under the two-phase commit protocol — every
+    per-proc commit marker matches its shard's bytes. A save missing
+    even one peer's commit (rank died between shard and marker, or the
+    marker itself was torn) is NOT a checkpoint."""
     try:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
@@ -71,7 +82,15 @@ def _sharded_valid(path: str) -> bool:
     if manifest.get("format") != "singa-tpu-sharded-v1":
         return False
     nprocs = int(manifest.get("nprocs", 1))
+    committed = manifest.get("commit") == coord.COMMIT_VERSION
     for k in range(nprocs):
+        if committed:
+            # the marker's whole-file size+CRC32 subsumes the zip
+            # member walk for tear detection — one read per shard on
+            # process 0's promotion path, not two
+            if not coord.commit_ok(path, k):
+                return False
+            continue
         shard = os.path.join(path, f"proc_{k}.npz")
         try:
             with zipfile.ZipFile(shard) as z:
@@ -104,7 +123,9 @@ def _fingerprint(path: str) -> tuple | None:
     try:
         if os.path.isdir(path):
             names = ["manifest.json"] + sorted(
-                f for f in os.listdir(path) if _PROC_RE.match(f)
+                f
+                for f in os.listdir(path)
+                if _PROC_RE.match(f) or _COMMIT_RE.match(f)
             )
             fp = []
             for name in names:
@@ -246,13 +267,15 @@ def apply_retention(folder: str, keep_last: int) -> list[str]:
 
 
 def remove_stale_shards(path: str, nprocs: int) -> list[str]:
-    """Remove ``proc_k.npz`` (and torn ``.tmp``) files in a sharded
-    checkpoint dir for k >= ``nprocs`` — leftovers from a previously
-    larger job that the loader would silently never read. The ONE copy
-    of this delete loop: ``save_sharded`` calls it with the live
-    process count before writing its manifest, ``gc_stale_shards``
-    with the manifest's own count for already-written dirs. Files for
-    k < nprocs are never touched (a peer process may be mid-write)."""
+    """Remove ``proc_k.npz`` / ``commit_k.json`` (and torn ``.tmp``)
+    files in a sharded checkpoint dir for k >= ``nprocs`` — leftovers
+    from a previously larger job that the loader would silently never
+    read (and whose stale commit markers would vouch for shards that no
+    longer belong to the save). The ONE copy of this delete loop:
+    ``save_sharded`` calls it with the live process count before
+    writing its manifest, ``gc_stale_shards`` with the manifest's own
+    count for already-written dirs. Files for k < nprocs are never
+    touched (a peer process may be mid-write)."""
     removed = []
     try:
         names = os.listdir(path)
@@ -260,7 +283,7 @@ def remove_stale_shards(path: str, nprocs: int) -> list[str]:
         return removed
     for fname in names:
         base = fname[:-4] if fname.endswith(".tmp") else fname
-        m = _PROC_RE.match(base)
+        m = _PROC_RE.match(base) or _COMMIT_RE.match(base)
         if m and int(m.group(1)) >= nprocs:
             full = os.path.join(path, fname)
             try:

@@ -122,12 +122,19 @@ def run(
                     f"supervisor: training complete at step "
                     f"{model_cfg.train_steps} (attempt {attempt})"
                 )
+                # deliberate exit: peers must not read our now-frozen
+                # heartbeat as a death (watchdog.py done sentinel)
+                ctx.mark_done()
                 return EXIT_OK
             except PreemptionDrained as e:
                 log(
                     f"supervisor: preempted at step {e.step} — "
                     f"exiting resumable (status {EXIT_RESUMABLE})"
                 )
+                if ctx.coordinated_exit:
+                    # every rank drained at this same step (or there is
+                    # only one) — a deliberate exit, not a death
+                    ctx.mark_done()
                 return EXIT_RESUMABLE
             except GuardGaveUp as e:
                 # a deterministic divergence replays identically after
@@ -156,6 +163,24 @@ def run(
                     f"({type(e).__name__}: {e}); {progress} step(s) of "
                     "progress since restore"
                 )
+                from .coord import process_count
+
+                if process_count() > 1:
+                    # a single rank restarting in-process would rejoin
+                    # peers whose collectives are steps ahead — they
+                    # can never re-align. Exit resumable instead: the
+                    # cluster launcher restarts EVERY rank from the
+                    # newest complete checkpoint (our peers' liveness
+                    # watchdogs turn their hung collectives into the
+                    # same resumable exit). NOT mark_done: peers must
+                    # see this exit as the death it is.
+                    log(
+                        "supervisor: multi-process job — skipping "
+                        "in-process restart (peers' collectives would "
+                        f"desync); exiting resumable ({EXIT_RESUMABLE}) "
+                        "so the launcher restarts all ranks together"
+                    )
+                    return EXIT_RESUMABLE
                 if failures > res.max_restarts:
                     log(
                         "supervisor: GIVING UP — "

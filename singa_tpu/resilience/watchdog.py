@@ -1,26 +1,51 @@
-"""Step-wall-clock watchdog: hung-collective detection.
+"""Step-wall-clock watchdog + peer-liveness heartbeats.
 
 A multi-host collective that loses a peer does not crash — it hangs, and
-the job burns its reservation in silence. The watchdog is a host-side
-daemon thread fed a heartbeat at every step/chunk boundary; when the gap
-since the last beat exceeds the configured timeout it dumps diagnostics
-(the stalled step number, the elapsed time, and every thread's Python
-stack) through the job log, once per stall. It never kills anything —
-the operator (or an external supervisor watching the log) decides;
-killing from a watchdog thread would turn a transient straggler into a
-guaranteed restart.
+the job burns its reservation in silence. Two defenses live here:
+
+**Stall diagnostics** (single- and multi-host): a host-side daemon
+thread fed a heartbeat at every step/chunk boundary; when the gap since
+the last beat exceeds the configured timeout it dumps diagnostics (the
+stalled step number, the elapsed time, and every thread's Python stack)
+through the job log, once per stall. It never kills anything — a
+transient straggler must not become a guaranteed restart.
+
+**Peer liveness** (multi-host): each rank's watchdog thread touches a
+per-rank heartbeat file (``<workspace>/heartbeats/rank_k.hb``) every
+poll — file freshness means "process alive", deliberately NOT "step
+advancing", so a peer grinding through a slow compile never reads as
+dead. When (a) our OWN step has been stalled longer than the peer
+deadline — we are stuck, almost certainly in a collective — and (b) a
+peer's heartbeat file is stale past the same deadline, the peer process
+is presumed dead and this rank exits with the RESUMABLE status (75): a
+forever-hung collective becomes a loud, launcher-restartable event. A
+rank that exits deliberately (trained to completion, or a coordinated
+preemption drain) publishes a ``rank_k.done`` sentinel first, so its
+now-frozen heartbeat is never mistaken for a death.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
 import traceback
 
+from .preemption import EXIT_RESUMABLE
+
+
+def heartbeat_file(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank_{rank}.hb")
+
+
+def done_file(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank_{rank}.done")
+
 
 class Watchdog:
-    """Monitor thread: ``beat(step)`` at boundaries, dump on stall."""
+    """Monitor thread: ``beat(step)`` at boundaries, dump on stall,
+    optionally watch peer heartbeats (``enable_heartbeats``)."""
 
     def __init__(self, timeout: float, log=print):
         self.timeout = float(timeout)
@@ -33,9 +58,68 @@ class Watchdog:
         self._thread: threading.Thread | None = None
         #: stall dumps emitted (tests and post-mortems read this)
         self.stalls = 0
+        #: peer-liveness state (None = disabled); see enable_heartbeats
+        self._hb: dict | None = None
+        #: orders mark_done's final touch+sentinel against the watch
+        #: thread's periodic touches (sentinel mtime must stay >= our
+        #: heartbeat mtime once we declare the exit deliberate)
+        self._hb_lock = threading.Lock()
+        #: peers this instance declared dead (tests read it; also keeps
+        #: a non-exiting on_peer_dead callback from firing per poll)
+        self.dead_peers: set[int] = set()
+
+    def enable_heartbeats(
+        self,
+        directory: str,
+        rank: int,
+        nprocs: int,
+        peer_timeout: float,
+        on_peer_dead=None,
+    ) -> None:
+        """Arm peer liveness BEFORE ``start()``: touch our own heartbeat
+        file every poll, and declare a peer dead when its file is stale
+        past ``peer_timeout`` seconds while our own step is stalled at
+        least as long. ``on_peer_dead(rank, age)`` defaults to a loud
+        resumable exit (os._exit(75)). Peers get a full ``peer_timeout``
+        of grace from the moment we arm — a rank still initializing is
+        not dead."""
+        os.makedirs(directory, exist_ok=True)
+        self._hb = {
+            "dir": directory,
+            "rank": int(rank),
+            "nprocs": int(nprocs),
+            "timeout": float(peer_timeout),
+            # wall clock, because it is compared against file mtimes
+            "enabled_at": time.time(),
+            "on_dead": on_peer_dead or self._exit_peer_dead,
+            "done": False,
+        }
+        # a fresh incarnation of this rank: a stale done sentinel from
+        # the previous run must not mask THIS run's death to our peers
+        try:
+            os.unlink(done_file(directory, int(rank)))
+        except OSError:
+            pass
+        self._touch_heartbeat()
+
+    def mark_done(self) -> None:
+        """Publish "this rank exited deliberately" (end of training, or
+        a coordinated drain): peers must not read the now-frozen
+        heartbeat as a death. The final heartbeat touch and the
+        sentinel write happen under the same lock the watch thread's
+        periodic touch takes, so sentinel mtime >= heartbeat mtime
+        holds — a racing touch can never reorder past it."""
+        hb = self._hb
+        if hb is None:
+            return
+        with self._hb_lock:
+            hb["done"] = True  # the watch thread stops touching
+            self._touch_heartbeat()
+            with open(done_file(hb["dir"], hb["rank"]), "w"):
+                pass
 
     def start(self) -> None:
-        if self.timeout <= 0 or self._thread is not None:
+        if (self.timeout <= 0 and self._hb is None) or self._thread:
             return
         self._stop.clear()
         self._thread = threading.Thread(
@@ -54,19 +138,99 @@ class Watchdog:
             self._last_beat = time.monotonic()
             self._last_step = step
 
-    def _watch(self) -> None:
+    # ------------------------------------------------------------------
+    # watch thread
+    # ------------------------------------------------------------------
+
+    def _poll_interval(self) -> float:
+        ts = [self.timeout]
+        if self._hb is not None:
+            ts.append(self._hb["timeout"])
+        ts = [t for t in ts if t > 0]
+        t = min(ts) if ts else 1.0
         # poll fast enough to catch a stall promptly without busy-waiting
-        poll = max(0.01, min(self.timeout / 4.0, 1.0))
+        return max(0.01, min(t / 4.0, 1.0))
+
+    def _watch(self) -> None:
+        poll = self._poll_interval()
         while not self._stop.wait(poll):
+            hb = self._hb
+            if hb is not None:
+                with self._hb_lock:
+                    if not hb["done"]:
+                        self._touch_heartbeat()
             with self._lock:
                 elapsed = time.monotonic() - self._last_beat
                 step, dumped = self._last_step, self._dumped_for
-            if elapsed <= self.timeout or step == dumped:
+            if hb is not None and elapsed > hb["timeout"]:
+                self._check_peers(hb)
+            if self.timeout <= 0 or elapsed <= self.timeout or step == dumped:
                 continue
             self._dump(step, elapsed)
             with self._lock:
                 self._dumped_for = step
                 self.stalls += 1
+
+    def _touch_heartbeat(self) -> None:
+        hb = self._hb
+        path = heartbeat_file(hb["dir"], hb["rank"])
+        try:
+            with open(path, "a"):
+                pass
+            os.utime(path, None)
+        except OSError:
+            pass  # a flaky shared FS must not kill the watchdog thread
+
+    @staticmethod
+    def _mtime(path: str) -> float | None:
+        try:
+            return os.stat(path).st_mtime
+        except OSError:
+            return None
+
+    def _check_peers(self, hb: dict) -> None:
+        """Our own step is stalled past the peer deadline — are we stuck
+        because a peer process died mid-collective?"""
+        now = time.time()
+        for k in range(hb["nprocs"]):
+            if k == hb["rank"] or k in self.dead_peers:
+                continue
+            hb_m = self._mtime(heartbeat_file(hb["dir"], k))
+            # grace from arming: a peer that has not beaten yet is
+            # (still) initializing, not dead
+            age = now - max(hb_m or 0.0, hb["enabled_at"])
+            if age <= hb["timeout"]:
+                continue
+            done_m = self._mtime(done_file(hb["dir"], k))
+            deliberate = (
+                done_m is not None
+                and (hb_m is None or done_m >= hb_m)
+                # a sentinel older than OUR arming (minus one deadline
+                # of clock slack) is a PREVIOUS incarnation's clean
+                # exit — a peer that died in THIS run before arming
+                # (it clears its own sentinel at enable_heartbeats)
+                # must not be masked by it
+                and done_m >= hb["enabled_at"] - hb["timeout"]
+            )
+            if deliberate:
+                continue  # deliberate exit (trained / coordinated drain)
+            self.dead_peers.add(k)
+            hb["on_dead"](k, age)
+
+    def _exit_peer_dead(self, rank: int, age: float) -> None:
+        hb = self._hb
+        self.log(
+            f"WATCHDOG: peer rank {rank} heartbeat stale {age:.1f}s "
+            f"(deadline {hb['timeout']:.1f}s) while this rank's step is "
+            "stalled — peer presumed dead mid-collective; exiting "
+            f"resumable ({EXIT_RESUMABLE}) so the launcher can restart "
+            "every rank from the last complete checkpoint"
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # the hung collective can never complete once the peer is gone;
+        # os._exit is the only exit that does not need the main thread
+        os._exit(EXIT_RESUMABLE)
 
     def _dump(self, step: int, elapsed: float) -> None:
         lines = [

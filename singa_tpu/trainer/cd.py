@@ -19,9 +19,11 @@ shardings, checkpointing, and observability.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from ..config.schema import ConfigError
 from ..layers.rbm import RBMLayer
+from ..resilience.guard import grad_norm_sq
 from .trainer import Trainer
 
 
@@ -78,12 +80,18 @@ class CDTrainer(Trainer):
 
     # ------------------------------------------------------------------
 
-    def _train_step_fn(self, params, state, buffers, step, batch, rng):
+    def _step_core(self, params, state, buffers, step, batch, rng, lr_scale):
         """One jitted CD step: walk the net through Net.forward (keeping
         its shared-param and connector invariants), swapping each RBM's
         compute for a Gibbs-chain update; then push the collected CD grads
         through the regular updater. Grads never flow *between* RBMs —
-        greedy layerwise training by construction."""
+        greedy layerwise training by construction.
+
+        Guard seam (resilience/guard.py): the verdict is the finiteness
+        of the CD grads' global norm AND every RBM's metrics (there is
+        no backprop loss to watch — a NaN batch surfaces in both), and
+        ``lr_scale`` folds into the CD grads exactly as it would into
+        backprop grads."""
         grads: dict = {}
         metrics: dict = {}
 
@@ -98,6 +106,14 @@ class CDTrainer(Trainer):
         self.train_net.forward(
             params, batch, training=True, rng=rng, layer_hook=hook
         )
+        ok = None
+        if lr_scale is not None:
+            ok = jnp.isfinite(grad_norm_sq(grads))
+            for leaf in jax.tree.leaves(metrics):
+                ok = ok & jnp.all(jnp.isfinite(leaf))
+            grads = jax.tree.map(
+                lambda g: g * lr_scale.astype(g.dtype), grads
+            )
         rbm_params = {n: params[n] for n in grads}
         rbm_state = {n: state[n] for n in grads}
         new_p, new_s = self.updater.apply(
@@ -105,7 +121,7 @@ class CDTrainer(Trainer):
         )
         params = {**params, **new_p}
         state = {**state, **new_s}
-        return params, state, buffers, metrics
+        return params, state, buffers, metrics, ok
 
     def _eval_batch_metrics(self, net, params, buffers, batch) -> dict:
         """Eval metric per RBM: mean-field reconstruction error.

@@ -45,6 +45,7 @@ from ..parallel.consistency import (
 from ..parallel.mesh import DATA_AXIS
 from ..parallel.shardings import replicated
 from ..params import init_params
+from ..resilience.guard import GUARD_KEYS, grad_norm_sq, init_guard_buffers
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .trainer import Trainer
@@ -172,6 +173,13 @@ class ReplicaTrainer(Trainer):
             )
             for n, v in buffers0.items()
         }
+        if self._guard is not None:
+            # guard counters are SCALAR and replicated — the verdict is
+            # global (any bad replica voids the step), so per-replica
+            # counters would only ever disagree by a bug
+            repl = replicated(self.mesh)
+            for k, v in init_guard_buffers().items():
+                self.buffers[k] = jax.device_put(v, repl)
         # server-side pytrees; materialized at bootstrap
         self.center: dict[str, jnp.ndarray] | None = None
         self.snapshot: dict[str, jnp.ndarray] | None = None
@@ -184,13 +192,22 @@ class ReplicaTrainer(Trainer):
     # compiled steps
     # ------------------------------------------------------------------
 
-    def _train_step_fn(self, params, state, buffers, step, batch, rng):
+    def _step_core(self, params, state, buffers, step, batch, rng, lr_scale):
         """vmap the per-replica forward/backward/update over the leading
         replica axis; metrics are averaged across replicas (each group
         reports its own Performance in the reference — one average is the
         honest aggregate). Buffers (batch-norm running stats) carry a
-        replica axis too: each replica evolves its own state."""
+        replica axis too: each replica evolves its own state.
+
+        Guard seam (resilience/guard.py): every replica computes its
+        own loss + grad-norm finiteness verdict inside the vmap; the
+        step's verdict is their conjunction — ANY bad replica voids the
+        WHOLE step, because the shared counters (and a rollback, which
+        restores every replica plus the ``.server`` sidecar) must stay
+        consistent across replicas. ``lr_scale`` (a replicated scalar)
+        broadcasts into each replica's grads."""
         rngs = jax.random.split(rng, self.nreplicas)
+        guarded = lr_scale is not None
 
         def one(p, s, b, feed, r):
             def loss_fn(pp):
@@ -201,17 +218,26 @@ class ReplicaTrainer(Trainer):
                 )
                 return loss, (metrics, new_b)
 
-            (_, (m, new_b)), grads = jax.value_and_grad(
+            (loss, (m, new_b)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(p)
+            ok_r = jnp.bool_(True)
+            if guarded:
+                ok_r = jnp.isfinite(loss) & jnp.isfinite(
+                    grad_norm_sq(grads)
+                )
+                grads = jax.tree.map(
+                    lambda g: g * lr_scale.astype(g.dtype), grads
+                )
             p2, s2 = self.updater.apply(step, p, grads, s, self.specs)
-            return p2, s2, new_b, m
+            return p2, s2, new_b, m, ok_r
 
-        params, state, buffers, metrics = jax.vmap(
+        params, state, buffers, metrics, ok_r = jax.vmap(
             one, in_axes=(0, 0, 0, 0, 0)
         )(params, state, buffers, batch, rngs)
         metrics = jax.tree.map(lambda x: jnp.mean(x, axis=0), metrics)
-        return params, state, buffers, metrics
+        ok = jnp.all(ok_r) if guarded else None
+        return params, state, buffers, metrics, ok
 
     def _build_sync(self):
         if self.protocol == "Elastic":
@@ -540,7 +566,13 @@ class ReplicaTrainer(Trainer):
         return {n: v[0] for n, v in self.params.items()}
 
     def _eval_buffers(self):
-        return {n: v[0] for n, v in self.buffers.items()}
+        # guard counters are scalars (no replica axis) and eval has no
+        # use for them anyway
+        return {
+            n: v[0]
+            for n, v in self.buffers.items()
+            if n not in GUARD_KEYS
+        }
 
     def _prepare_save(self, folder: str, step: int, snapshot: bool):
         """Extend the base save with the ``.server`` sidecar (center +
@@ -617,7 +649,12 @@ class ReplicaTrainer(Trainer):
 
         def write_with_sidecar() -> None:
             write()
-            save_checkpoint(path + ".server", step, server, snap)
+            # the sidecar is a host-global npz, identical on every rank
+            # (host_view allgathered it above, on ALL ranks — that part
+            # is collective and must stay on the main thread): one
+            # writer, like the base npz path
+            if jax.process_index() == 0:
+                save_checkpoint(path + ".server", step, server, snap)
 
         return path, write_with_sidecar
 
@@ -701,7 +738,12 @@ class ReplicaTrainer(Trainer):
             for n, slots in state.items()
         }
         self.buffers = {
-            n: jax.device_put(v, self._rep_buf_sh)
+            # guard counters are replicated scalars, never replica-axis
+            n: jax.device_put(
+                v,
+                replicated(self.mesh) if n in GUARD_KEYS
+                else self._rep_buf_sh,
+            )
             for n, v in buffers.items()
         }
         server = path + ".server"

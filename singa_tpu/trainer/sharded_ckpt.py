@@ -9,6 +9,14 @@ bandwidth. This format writes what each PROCESS already holds:
                            (shape, dtype, PartitionSpec) — process 0
   <dir>/proc_<k>.npz       process k's addressable shards, one entry per
                            (array, device) with its global index box
+  <dir>/commit_<k>.json    process k's two-phase-commit marker: a CRC32
+                           + size digest of its shard file, published
+                           AFTER the shard lands (resilience/coord.py).
+                           Process 0 promotes LATEST only once every
+                           marker is present and matches; validation
+                           requires them too, so a save where any rank
+                           died between shard and marker is never
+                           resumable
 
 Save never materializes a global array: each device shard's data moves
 device->host individually (replica 0 only, so replicated arrays cost
@@ -107,6 +115,12 @@ def save_sharded(
     with open(shard_file + ".tmp", "wb") as f:
         np.savez(f, **entries)
     os.replace(shard_file + ".tmp", shard_file)
+    # phase 1 of the two-phase commit: vouch for the shard we just
+    # published (resilience/coord.py). Marker AFTER shard, atomically —
+    # a present marker always describes a fully-written shard.
+    from ..resilience.coord import COMMIT_VERSION, write_commit
+
+    write_commit(path, proc)
 
     if proc == 0:
         # a re-save into a dir written by a LARGER job must not leave
@@ -124,6 +138,7 @@ def save_sharded(
             "step": int(step),
             "streams": dict(streams or {}),
             "nprocs": jax.process_count(),
+            "commit": COMMIT_VERSION,
             "arrays": meta,
         }
         mpath = os.path.join(path, "manifest.json")

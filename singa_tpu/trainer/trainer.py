@@ -41,13 +41,11 @@ from ..params import init_params
 from ..resilience.guard import (
     GUARD_BAD,
     GUARD_CONSEC,
-    GUARD_KEYS,
     GUARD_LR,
     GuardSpec,
-    apply_verdict,
     grad_norm_sq,
+    guarded_step,
     init_guard_buffers,
-    step_guard_buffers,
 )
 from ..utils import Performance, Timers, dump_net_json
 from .checkpoint import (
@@ -116,16 +114,10 @@ class Trainer:
         # --- resilience seams (resilience/context.py): the supervisor
         # (or a test) attaches a ResilienceContext; None = inert ---
         self.resilience = None
+        # every engine supports the guard through the shared _step_core
+        # seam (resilience/guard.py guarded_step): each core reports
+        # its own finiteness verdict, the wrapper applies the policy
         self._guard = GuardSpec.from_config(model_cfg.resilience)
-        if (
-            self._guard is not None
-            and type(self)._train_step_fn is not Trainer._train_step_fn
-        ):
-            raise ConfigError(
-                f"resilience.guard_policy {self._guard.policy!r} needs the "
-                f"backprop engine's train step; {type(self).__name__} "
-                "overrides it and does not thread the guard verdict"
-            )
         root = jax.random.PRNGKey(seed)
         self._init_key, self._step_key = jax.random.split(root)
 
@@ -593,9 +585,31 @@ class Trainer:
         )
 
     def _train_step_fn(self, params, state, buffers, step, batch, rng):
-        """One forward+backward+update. Stateful layers' buffer updates
-        (batch-norm running stats) ride the has_aux output — plain
-        forward values, outside any gradient path."""
+        """One train step: the engine's ``_step_core`` update, wrapped
+        by the shared divergence guard when one is configured
+        (resilience/guard.py guarded_step — the verdict folds into the
+        step's existing outputs, zero per-step host syncs)."""
+        if self._guard is None:
+            params, state, buffers, metrics, _ = self._step_core(
+                params, state, buffers, step, batch, rng, None
+            )
+            return params, state, buffers, metrics
+        return guarded_step(
+            self._step_core, params, state, buffers, step, batch, rng
+        )
+
+    def _step_core(self, params, state, buffers, step, batch, rng, lr_scale):
+        """One forward+backward+update -> (params, state, buffers,
+        metrics, ok). Stateful layers' buffer updates (batch-norm
+        running stats) ride the has_aux output — plain forward values,
+        outside any gradient path.
+
+        The engine-specific half of the guard seam: ``lr_scale`` is
+        None for unguarded runs (``ok`` is then unused); guarded, it is
+        the accumulated rollback LR backoff — multiplying the grads
+        inside the program (scale 1.0 is a bitwise no-op) means backing
+        off needs no recompile and no host sync — and ``ok`` is this
+        engine's finiteness verdict: loss + global grad-norm."""
 
         def loss_fn(p):
             loss, metrics, new_buffers = self.train_net.forward(
@@ -608,41 +622,16 @@ class Trainer:
         (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
-        if self._guard is None:
-            params, state = self.updater.apply(
-                step, params, grads, state, self.specs
+        ok = None
+        if lr_scale is not None:
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm_sq(grads))
+            grads = jax.tree.map(
+                lambda g: g * lr_scale.astype(g.dtype), grads
             )
-            return params, state, new_buffers, metrics
-        # --- divergence guard (resilience/guard.py): one fused
-        # on-device finiteness verdict over loss + global grad-norm; a
-        # bad step's updates are dropped via where(ok, new, old) and the
-        # counters ride the buffer pytree — the verdict folds into the
-        # step's existing outputs, zero per-step host syncs ---
-        ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm_sq(grads))
-        lr_scale = buffers[GUARD_LR]
-        # rollback's LR backoff: the accumulated scale multiplies the
-        # grads inside the program (scale 1.0 is a bitwise no-op), so
-        # backing off needs no recompile and no host sync
-        grads = jax.tree.map(
-            lambda g: g * lr_scale.astype(g.dtype), grads
-        )
-        new_params, new_state = self.updater.apply(
+        params, state = self.updater.apply(
             step, params, grads, state, self.specs
         )
-        params = apply_verdict(ok, new_params, params)
-        state = apply_verdict(ok, new_state, state)
-        layer_new = {
-            k: v for k, v in new_buffers.items() if k not in GUARD_KEYS
-        }
-        layer_old = {k: buffers[k] for k in layer_new}
-        out_buffers = dict(apply_verdict(ok, layer_new, layer_old))
-        out_buffers.update(step_guard_buffers(ok, buffers))
-        # a skipped step's metrics would otherwise pollute the display
-        # window's running sums with NaN; report zeros for it instead
-        metrics = jax.tree.map(
-            lambda m: jnp.where(ok, m, jnp.zeros_like(m)), metrics
-        )
-        return params, state, out_buffers, metrics
+        return params, state, new_buffers, metrics, ok
 
     def _eval_batch_metrics(self, net: Net, params, buffers, batch) -> dict:
         """One eval batch -> {losslayer: metrics}. The single overridable
@@ -1341,11 +1330,22 @@ class Trainer:
 
         else:
             path = os.path.join(folder, f"step_{step}.npz")
+            if jax.process_index() != 0:
+                # npz checkpoints are host-gathered and identical on
+                # every rank (the spanning check above upgraded any
+                # partitioned state to the sharded format): one writer
+                # suffices, and N ranks racing os.replace on the same
+                # shared-FS file is N-1 wasted writes plus a window for
+                # a half-renamed observation. Rank 0 writes.
+                def write() -> None:
+                    return None
 
-            def write() -> None:
-                save_checkpoint(
-                    path, step, params, state, buffers, streams=streams
-                )
+            else:
+
+                def write() -> None:
+                    save_checkpoint(
+                        path, step, params, state, buffers, streams=streams
+                    )
 
         return path, write
 

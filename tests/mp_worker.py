@@ -6,7 +6,11 @@ each host (examples/mnist/run.sh:19-37) — then dumps the trained params
 and run metadata for the parent test to compare across ranks.
 
 Usage: python mp_worker.py <procsid> <model_conf> <cluster_conf> \
-           <hostfile> <out_npz>
+           <hostfile> <out_npz> [faults]
+
+A non-zero CLI exit (e.g. the resumable 75 from a coordinated drain or
+a peer-death watchdog exit) propagates as this process's exit code; the
+params/meta dump is only written for clean (rc 0) runs.
 """
 
 import json
@@ -29,6 +33,7 @@ jax.config.update("jax_platforms", "cpu")
 
 def run() -> int:
     procsid, model_conf, cluster_conf, hostfile, out = sys.argv[1:6]
+    faults = sys.argv[6] if len(sys.argv) > 6 else None
 
     import numpy as np
 
@@ -43,13 +48,20 @@ def run() -> int:
         captured["trainer"] = t
         return t
 
+    # the supervisor resolves make_trainer lazily from singa_tpu.trainer
+    # (resilience/supervisor.py), so patch THAT module; the cli attr is
+    # kept for any direct-main path
+    trainer_mod.make_trainer = capturing_make
     cli.make_trainer = capturing_make
-    rc = cli.main([
+    argv = [
         "-model_conf", model_conf,
         "-cluster_conf", cluster_conf,
         "-procsID", procsid,
         "-hostfile", hostfile,
-    ])
+    ]
+    if faults:
+        argv += ["-faults", faults]
+    rc = cli.main(argv)
     if rc != 0:
         return rc
 

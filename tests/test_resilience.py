@@ -684,18 +684,149 @@ def test_async_cd_engine_checkpoints(tmp_path):
     assert any(name.endswith("weight") for name in params)
 
 
-def test_guard_rejected_on_non_backprop_engine(tmp_path):
-    """Engines that override the train step (CD) must reject a guard
-    config loudly instead of silently not guarding."""
-    cfg, cl, _ = make_job(
-        tmp_path, train_steps=4, checkpoint_frequency=0,
+# ---------------------------------------------------------------------------
+# divergence guard on the replica and CD engines (shared _step_core seam)
+# ---------------------------------------------------------------------------
+
+
+def _replica_job(root, *, train_steps, checkpoint_frequency, resilience):
+    """make_job reshaped into a ReplicaTrainer job (Elastic protocol,
+    2 replicas over the data axis)."""
+    cfg, cl, ck_dir = make_job(
+        root,
+        train_steps=train_steps,
+        checkpoint_frequency=checkpoint_frequency,
+        resilience=resilience,
+    )
+    cfg.updater.param_type = "Elastic"
+    cfg.updater.moving_rate = 0.3
+    cfg.updater.sync_frequency = 2
+    cfg.updater.warmup_steps = 2
+    cl.nservers = 1
+    cl.bandwidth = 1e9
+    return cfg, cl, ck_dir
+
+
+def _run_guarded(trainer_cls, cfg, cl, faults, **kwargs):
+    from singa_tpu.resilience import FaultPlan, ResilienceContext
+
+    ctx = ResilienceContext(
+        cfg.resilience, FaultPlan.parse(faults), log=lambda s: None
+    )
+    trainer = trainer_cls(
+        cfg, cl, seed=3, log=lambda s: None, prefetch=False, **kwargs
+    )
+    ctx.bind(trainer)
+    try:
+        trainer.run()
+    finally:
+        ctx.stop()
+    return trainer, ctx
+
+
+def test_replica_guard_skip(tmp_path):
+    """nanloss@5 on the replica engine under kSkip: every replica's bad
+    update is dropped (the verdict is global — any bad replica voids
+    the whole step), counters record ONE bad step, training finishes
+    finite. Mirrors test_nanloss_skip_policy."""
+    from singa_tpu.parallel import build_mesh
+    from singa_tpu.trainer import ReplicaTrainer
+
+    cfg, cl, _ = _replica_job(
+        tmp_path, train_steps=10, checkpoint_frequency=0,
         resilience="guard_policy: kSkip",
     )
-    cfg.alg = "kContrastiveDivergence"
+    trainer, _ = _run_guarded(
+        ReplicaTrainer, cfg, cl, "nanloss@5", mesh=build_mesh(2, 1)
+    )
+    counters = trainer.guard_counters()
+    assert counters["bad_steps"] == 1
+    assert counters["consecutive_bad"] == 0
+    assert counters["lr_scale"] == 1.0
+    for name, v in trainer.params.items():
+        assert np.isfinite(np.asarray(v)).all(), name
+
+
+def test_replica_guard_rollback(tmp_path):
+    """nanloss@6 on the replica engine under kRollback(after=1): the
+    guard restores step_4 — replicas AND the .server sidecar (center/
+    snapshot ride the engine's own resume path) — backs the LR off,
+    and the run completes finite. Mirrors test_nanloss_rollback_policy."""
+    from singa_tpu.parallel import build_mesh
+    from singa_tpu.trainer import ReplicaTrainer
+
+    cfg, cl, ck_dir = _replica_job(
+        tmp_path, train_steps=12, checkpoint_frequency=4,
+        resilience=(
+            "guard_policy: kRollback guard_rollback_after: 1 "
+            "guard_lr_backoff: 0.5"
+        ),
+    )
+    trainer, ctx = _run_guarded(
+        ReplicaTrainer, cfg, cl, "nanloss@6", mesh=build_mesh(2, 1)
+    )
+    assert ctx.rollbacks == 1
+    counters = trainer.guard_counters()
+    # the restore rewound bad_steps with the rest of the buffers; the
+    # compounded LR backoff is the rollback's surviving fingerprint
+    assert counters["lr_scale"] == 0.5
+    # the rollback restored the bootstrapped server state too
+    assert trainer._bootstrapped and trainer.center is not None
+    for name, v in trainer.params.items():
+        assert np.isfinite(np.asarray(v)).all(), name
+    step, _, _, buffers = load_checkpoint(
+        retention.resolve_latest(ck_dir)
+    )
+    assert step == 12
+    assert float(buffers["__guard_lr_scale__"]) == 0.5
+
+
+def test_cd_guard_skip(tmp_path):
+    """nanloss@4 on the CD engine under kSkip: the CD grads' NaN trips
+    the verdict (there is no backprop loss), the update is dropped,
+    counters record it. Mirrors test_nanloss_skip_policy."""
+    from test_cd import make_rbm_conf
+
+    from singa_tpu.config.schema import ResilienceConfig
     from singa_tpu.trainer import CDTrainer
 
-    with pytest.raises(ConfigError, match="guard"):
-        CDTrainer(cfg, cl, seed=0, log=lambda s: None, prefetch=False)
+    cfg = make_rbm_conf(tmp_path, train_steps=8)
+    cfg.resilience = ResilienceConfig()
+    cfg.resilience.guard_policy = "kSkip"
+    trainer, _ = _run_guarded(CDTrainer, cfg, None, "nanloss@4")
+    counters = trainer.guard_counters()
+    assert counters["bad_steps"] == 1
+    assert counters["consecutive_bad"] == 0
+    assert counters["lr_scale"] == 1.0
+    for name, v in trainer.params.items():
+        assert np.isfinite(np.asarray(v)).all(), name
+
+
+def test_cd_guard_rollback(tmp_path):
+    """nanloss@7 on the CD engine under kRollback(after=1): restore the
+    step_6 save, back the LR off, finish finite. Mirrors
+    test_nanloss_rollback_policy."""
+    from test_cd import make_rbm_conf
+
+    from singa_tpu.config.schema import ResilienceConfig
+    from singa_tpu.trainer import CDTrainer
+
+    cfg = make_rbm_conf(tmp_path, train_steps=9)
+    cfg.checkpoint_frequency = 3
+    cfg.resilience = ResilienceConfig()
+    cfg.resilience.guard_policy = "kRollback"
+    cfg.resilience.guard_rollback_after = 1
+    cfg.resilience.guard_lr_backoff = 0.5
+    cluster = ClusterConfig()
+    cluster.workspace = str(tmp_path / "ws")
+    trainer, ctx = _run_guarded(CDTrainer, cfg, cluster, "nanloss@7")
+    assert ctx.rollbacks == 1
+    counters = trainer.guard_counters()
+    # bad_steps rewound with the restored buffers; the LR backoff is
+    # the rollback's surviving fingerprint
+    assert counters["lr_scale"] == 0.5
+    for name, v in trainer.params.items():
+        assert np.isfinite(np.asarray(v)).all(), name
 
 
 def test_resilience_block_lint_coverage():
@@ -742,6 +873,24 @@ def test_resilience_block_lint_coverage():
         d.code == "CFG001" and "async_checkpoint" in (d.fix_hint or "")
         for d in col.sorted()
     )
+    # the cluster-coordination knobs are schema-covered too
+    for typo, want in (
+        ("coordinate_premption: true", "coordinate_preemption"),
+        ("heartbeat_timeout: 5", "heartbeat_timeout_s"),
+        ("commit_timeout: 5", "commit_timeout_s"),
+    ):
+        col = Collector()
+        lint_model_text(
+            base.replace(
+                "resilience { max_restarts: 3",
+                "resilience { " + typo + " max_restarts: 3",
+            ),
+            "job.conf", col,
+        )
+        assert any(
+            d.code == "CFG001" and want in (d.fix_hint or "")
+            for d in col.sorted()
+        ), typo
 
 
 # ---------------------------------------------------------------------------
@@ -859,3 +1008,240 @@ def test_rollback_livelock_gives_up(tmp_path):
         supervisor.run(cfg, cl, seed=3, log=logs.append, prefetch=False)
     assert any("GIVING UP" in l for l in logs)
     assert any("rolling back" in l for l in logs)  # it did try first
+
+
+# ---------------------------------------------------------------------------
+# cluster coordination plane (resilience/coord.py)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_rank_qualifier():
+    """``kind@at[:rank=K]``: a rank-qualified fault only fires on its
+    target process — and on every other rank it stays UNFIRED."""
+    from singa_tpu.resilience import FaultSpec
+
+    plan = FaultPlan.parse(
+        "sigterm@12:rank=1, crash@7:rank=0,slowstep@9=0.5:rank=1"
+    )
+    assert [(s.kind, s.at, s.value, s.rank) for s in plan.specs] == [
+        ("sigterm", 12, None, 1),
+        ("crash", 7, None, 0),
+        ("slowstep", 9, 0.5, 1),
+    ]
+    assert str(plan.specs[0]) == "sigterm@12:rank=1"
+    assert str(plan.specs[2]) == "slowstep@9=0.5:rank=1"
+    assert str(FaultSpec("crash", 7)) == "crash@7"
+    # this test process is rank 0: rank-1 faults neither fire nor burn
+    assert plan.fire("sigterm", 12) is None
+    assert not plan.specs[0].fired
+    assert plan.fire("crash", 7) is not None
+    for bad in ("crash@7:rank=x", "crash@7:bogus=1", "crash@7:rank=-1"):
+        with pytest.raises(FaultPlanError):
+            FaultPlan.parse(bad)
+
+
+def test_sharded_save_two_phase_commit_markers(tmp_path):
+    """save_sharded publishes a CRC'd commit marker after its shard;
+    validation requires it, and a shard torn AFTER the marker landed
+    (the corrupt_ckpt window) fails the marker's CRC."""
+    import json
+
+    import jax.numpy as jnp
+
+    from singa_tpu.resilience import coord, tear_file
+    from singa_tpu.trainer.sharded_ckpt import save_sharded
+
+    path = str(tmp_path / "step_3.ckpt")
+    save_sharded(path, 3, {"w": jnp.ones((4, 2))})
+    assert os.path.exists(os.path.join(path, "commit_0.json"))
+    with open(os.path.join(path, "manifest.json")) as f:
+        assert json.load(f)["commit"] == coord.COMMIT_VERSION
+    assert coord.commit_ok(path, 0)
+    assert retention.validate_checkpoint(path)
+    retention.validation_cache_clear()
+    tear_file(path)  # tears proc_0.npz
+    assert not coord.commit_ok(path, 0)
+    assert not retention.validate_checkpoint(path)
+
+
+def test_torn_commit_marker_never_resumable(tmp_path):
+    """A sharded save whose commit marker is torn — or missing — is
+    never trusted: resume falls back to the previous complete save
+    (the two-phase protocol's restore-side half)."""
+    logs = []
+    cfg, cl, ck_dir = make_job(
+        tmp_path, train_steps=12, checkpoint_frequency=5
+    )
+    cfg.checkpoint_format = "sharded"
+    rc = supervisor.run(
+        cfg, cl, seed=3, log=logs.append, prefetch=False
+    )
+    assert rc == EXIT_OK
+    latest = retention.resolve_latest(ck_dir)
+    assert latest is not None and latest.endswith("step_12.ckpt")
+    marker = os.path.join(latest, "commit_0.json")
+    assert os.path.exists(marker)
+    # torn marker: truncated mid-write by a dying process
+    retention.validation_cache_clear()
+    with open(marker, "r+b") as f:
+        f.truncate(3)
+    assert not retention.validate_checkpoint(latest)
+    fallback = retention.resolve_latest(ck_dir)
+    assert fallback is not None and fallback.endswith("step_10.ckpt")
+    # marker missing entirely: rank died between shard and commit
+    os.unlink(marker)
+    assert not retention.validate_checkpoint(latest)
+    assert retention.resolve_latest(ck_dir).endswith("step_10.ckpt")
+
+
+def test_commit_deadline_degrades_to_torn(tmp_path):
+    """await_commits past its deadline judges the save TORN, loudly —
+    never early, never with whatever shards happen to exist."""
+    import json
+
+    from singa_tpu.resilience import coord
+
+    d = tmp_path / "step_4.ckpt"
+    d.mkdir()
+    with open(d / "proc_0.npz", "wb") as f:
+        np.savez(f, x=np.zeros(2))
+    coord.write_commit(str(d), 0)
+    manifest = {
+        "format": "singa-tpu-sharded-v1",
+        "step": 4,
+        "nprocs": 2,  # rank 1's commit never lands
+        "commit": coord.COMMIT_VERSION,
+        "arrays": {},
+    }
+    (d / "manifest.json").write_text(json.dumps(manifest))
+    logs = []
+    assert (
+        coord.await_commits(str(d), timeout=0.2, log=logs.append)
+        is False
+    )
+    assert any("TORN" in l and "deadline" in l for l in logs)
+    assert not retention.validate_checkpoint(str(d))
+
+
+def test_half_committed_save_never_promoted(tmp_path):
+    """checkpoint_written's promotion phase: a sharded save missing a
+    peer's commit marker is judged torn at the deadline and LATEST
+    keeps naming the previous complete save."""
+    import jax.numpy as jnp
+
+    from singa_tpu.config.schema import ResilienceConfig
+    from singa_tpu.resilience import FaultPlan, ResilienceContext
+    from singa_tpu.trainer.sharded_ckpt import save_sharded
+
+    folder = tmp_path / "checkpoints"
+    folder.mkdir()
+    good = str(folder / "step_2.ckpt")
+    save_sharded(good, 2, {"w": jnp.ones((2,))})
+    retention.mark_latest(str(folder), good)
+    # half-committed step_4: shard landed, marker never did (the rank
+    # died between the two phases)
+    bad = str(folder / "step_4.ckpt")
+    save_sharded(bad, 4, {"w": jnp.ones((2,))})
+    os.unlink(os.path.join(bad, "commit_0.json"))
+    res = ResilienceConfig()
+    res.commit_timeout_s = 0.2
+    logs = []
+    ctx = ResilienceContext(res, FaultPlan(), log=logs.append)
+    ctx.checkpoint_written(None, bad, 4)
+    assert any("TORN" in l for l in logs)
+    with open(folder / "LATEST") as f:
+        assert f.read().strip() == "step_2.ckpt"
+    assert retention.resolve_latest(str(folder)).endswith("step_2.ckpt")
+
+
+def test_peer_liveness_declares_dead_peer(tmp_path):
+    """Our step is stalled AND the peer's heartbeat is stale: the peer
+    is presumed dead and on_peer_dead fires (the default exits 75)."""
+    import time
+
+    from singa_tpu.resilience.watchdog import Watchdog, heartbeat_file
+
+    events, logs = [], []
+    w = Watchdog(0.0, log=logs.append)
+    w.enable_heartbeats(
+        str(tmp_path), rank=0, nprocs=2, peer_timeout=0.2,
+        on_peer_dead=lambda r, age: events.append(r),
+    )
+    w.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        w.stop()
+    assert events == [1]
+    assert w.dead_peers == {1}
+    # our own liveness was published throughout
+    assert os.path.exists(heartbeat_file(str(tmp_path), 0))
+
+
+def test_peer_liveness_done_sentinel_suppresses(tmp_path):
+    """A peer that exited deliberately (mark_done: trained to
+    completion or coordinated drain) is never declared dead."""
+    import time
+
+    from singa_tpu.resilience.watchdog import (
+        Watchdog,
+        done_file,
+        heartbeat_file,
+    )
+
+    with open(heartbeat_file(str(tmp_path), 1), "w"):
+        pass
+    time.sleep(0.01)
+    with open(done_file(str(tmp_path), 1), "w"):
+        pass
+    events = []
+    w = Watchdog(0.0, log=lambda s: None)
+    w.enable_heartbeats(
+        str(tmp_path), rank=0, nprocs=2, peer_timeout=0.2,
+        on_peer_dead=lambda r, age: events.append(r),
+    )
+    w.start()
+    time.sleep(0.8)
+    w.stop()
+    assert events == []
+
+
+def test_peer_liveness_requires_own_stall(tmp_path):
+    """A rank whose own steps are advancing never declares peers dead,
+    however stale their files look — liveness only matters once WE are
+    stuck in a collective."""
+    import time
+
+    from singa_tpu.resilience.watchdog import Watchdog
+
+    events = []
+    w = Watchdog(0.0, log=lambda s: None)
+    w.enable_heartbeats(
+        str(tmp_path), rank=0, nprocs=2, peer_timeout=0.2,
+        on_peer_dead=lambda r, age: events.append(r),
+    )
+    w.start()
+    end = time.monotonic() + 0.8
+    i = 0
+    while time.monotonic() < end:
+        w.beat(i)
+        i += 1
+        time.sleep(0.02)
+    w.stop()
+    assert events == []
+
+
+def test_mark_done_publishes_sentinel(tmp_path):
+    from singa_tpu.resilience.watchdog import Watchdog, done_file
+
+    w = Watchdog(0.0, log=lambda s: None)
+    w.enable_heartbeats(
+        str(tmp_path), rank=0, nprocs=2, peer_timeout=1.0,
+        on_peer_dead=lambda r, age: None,
+    )
+    # a previous incarnation's sentinel was cleared at arming
+    assert not os.path.exists(done_file(str(tmp_path), 0))
+    w.mark_done()
+    assert os.path.exists(done_file(str(tmp_path), 0))
