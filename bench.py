@@ -554,8 +554,14 @@ def _write_bench_file(line: str) -> None:
     )
     path = os.environ.get("SINGA_TPU_BENCH_OUT", default)
     try:
-        with open(path, "w") as f:
+        # tmp + atomic rename: a crash mid-dump (the warm-start probe
+        # can hard-crash jaxlib in-process) must leave either the
+        # previous complete record or the new one — never a torn,
+        # unparseable BENCH.json that poisons trajectory tooling
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(line + "\n")
+        os.replace(tmp, path)
     except OSError as e:
         print(f"bench: could not write {path}: {e}", file=sys.stderr)
 

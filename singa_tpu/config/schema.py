@@ -659,6 +659,32 @@ class ResilienceConfig(Message):
     }
 
 
+class TelemetryConfig(Message):
+    """singa-tpu extension: the flight-recorder telemetry plane
+    (singa_tpu/obs/). Always-on by default — a job with a workspace
+    writes per-rank JSONL event logs to ``<workspace>/events/`` with
+    zero added per-step device syncs (events buffer in memory and flush
+    at display-cadence boundaries). ``tools/trace.py`` merges the
+    per-rank logs into one Perfetto-loadable trace.json. The reference
+    had only the Worker display line (src/worker/worker.cc:350-386);
+    this block is its post-mortem-grade replacement."""
+
+    FIELDS = {
+        # master switch: false silences the event log, span recording,
+        # and the profile@K trigger (the display line is unaffected)
+        "enabled": Field("bool", True),
+        # record every timed phase occurrence (train/data/eval/ckpt,
+        # feeder/stager threads, async-ckpt writer, coord barriers) as a
+        # span — the Chrome-trace tracks. false = lifecycle events only.
+        "trace_spans": Field("bool", True),
+        # per-rank event logs land in <workspace>/<events_subfolder>/
+        "events_subfolder": Field("string", "events"),
+        # jax.profiler traces from profile@K triggers land in
+        # <workspace>/<profile_subfolder>/
+        "profile_subfolder": Field("string", "xprof"),
+    }
+
+
 class ModelConfig(Message):
     FIELDS = {
         "name": Field("string"),
@@ -702,6 +728,9 @@ class ModelConfig(Message):
         # --- singa-tpu extension: fault-tolerance runtime (supervised
         # auto-resume, preemption drain, divergence guard, watchdog) ---
         "resilience": Field("message", message=ResilienceConfig),
+        # --- singa-tpu extension: flight-recorder telemetry plane
+        # (singa_tpu/obs/). Absent = enabled with defaults ---
+        "telemetry": Field("message", message=TelemetryConfig),
     }
 
 

@@ -15,6 +15,16 @@ the newest complete checkpoint, SIGTERM/SIGINT drain with a resumable
 exit status (75), the divergence guard, and the step watchdog. The
 ``-faults`` flag (or SINGA_TPU_FAULTS) injects a deterministic fault
 plan — ``crash@7,sigterm@12,nanloss@5`` — for recovery drills and CI.
+
+Telemetry (singa_tpu/obs/) is always on for jobs with a workspace: each
+rank appends structured lifecycle events and phase spans to
+``<workspace>/events/rank_k.jsonl`` (flushed at display cadence — the
+step path gains no syscalls or device syncs); ``python -m
+singa_tpu.tools.trace <workspace>`` merges them into one
+Perfetto-loadable trace.json. A ``profile@K:steps=N`` term in the fault
+plan brackets steps K..K+N with a ``jax.profiler`` trace into
+``<workspace>/xprof/``. The ``telemetry { ... }`` config block tunes or
+disables all of it.
 """
 
 from __future__ import annotations
@@ -39,7 +49,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     ap.add_argument(
         "-faults",
         default=os.environ.get("SINGA_TPU_FAULTS"),
-        help="deterministic fault plan, e.g. 'crash@7,sigterm@12' "
+        help="deterministic fault plan, e.g. 'crash@7,sigterm@12', or a "
+        "'profile@20:steps=5' jax.profiler trigger "
         "(resilience/faults.py grammar; also via SINGA_TPU_FAULTS)",
     )
     return ap.parse_args(argv)

@@ -52,6 +52,7 @@ with silently-unsaved state.
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 
@@ -74,6 +75,11 @@ class AsyncCheckpointer:
     def __init__(self, plan: FaultPlan | None = None, log=print):
         self.plan = plan if plan is not None else FaultPlan()
         self.log = log
+        #: flight recorder (obs/recorder.py): each background write
+        #: becomes a span on its own 'ckpt_writer' track, so a merged
+        #: trace shows the write pipeline overlapping the step stream.
+        #: None = telemetry off. The recorder is thread-safe.
+        self.recorder = None
         self._q: queue.Queue = queue.Queue(maxsize=_PENDING_SLOTS)
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -169,8 +175,15 @@ class AsyncCheckpointer:
                 self._q.task_done()
                 return
             step, path, write_fn, on_written = item
+            rec = self.recorder
+            span = (
+                rec.span("write_checkpoint", track="ckpt_writer")
+                if rec is not None
+                else contextlib.nullcontext()
+            )
             try:
-                write_fn()
+                with span:
+                    write_fn()
                 self.write_ordinal += 1
                 spec = self.plan.fire("async_torn_write", self.write_ordinal)
                 if spec is not None:

@@ -41,10 +41,20 @@ class ResilienceContext:
         res_cfg: ResilienceConfig | None = None,
         plan: FaultPlan | None = None,
         log=print,
+        recorder=None,
     ):
         self.cfg = res_cfg if res_cfg is not None else ResilienceConfig()
         self.plan = plan if plan is not None else FaultPlan()
         self.log = log
+        #: flight recorder (obs/recorder.py); None = telemetry off. The
+        #: plan shares it so every fault firing is an event regardless
+        #: of which seam fired it.
+        self.recorder = recorder
+        self.plan.recorder = recorder
+        #: profile@K trigger state (jax.profiler bracket)
+        self._profiling = False
+        self._profile_stop_at: int | None = None
+        self._profile_dir: str | None = None
         self.preemption = PreemptionHandler()
         self.watchdog = Watchdog(self.cfg.watchdog_timeout, log)
         #: zero-stall checkpoint pipeline (resilience/async_ckpt.py);
@@ -93,6 +103,22 @@ class ResilienceContext:
         trainer.resilience = self
         self.ckpt_dir = trainer._checkpoint_dir()
         self._nprocs = coord.process_count()
+        if self.recorder is not None:
+            # one recorder spans restart attempts, like the fault plan;
+            # each trainer incarnation re-wires its timers' span sink
+            trainer.attach_telemetry(self.recorder)
+            self.watchdog.recorder = self.recorder
+            if self.async_ckpt is not None:
+                self.async_ckpt.recorder = self.recorder
+        #: where profile@K traces land (resolved at bind: needs the
+        #: trainer's cluster workspace + telemetry block)
+        self._profile_dir = None
+        if trainer.cluster is not None and trainer.cluster.workspace:
+            tel = getattr(trainer.cfg, "telemetry", None)
+            sub = tel.profile_subfolder if tel is not None else "xprof"
+            self._profile_dir = os.path.join(
+                trainer.cluster.workspace, sub
+            )
         # peer-liveness heartbeats (watchdog.py): each rank's watchdog
         # thread touches <workspace>/heartbeats/rank_k.hb while the
         # process lives; a peer file stale past heartbeat_timeout_s
@@ -120,6 +146,10 @@ class ResilienceContext:
         self.watchdog.mark_done()
 
     def stop(self) -> None:
+        # a profile bracket the run never reached the end of (early
+        # drain, crash, train_steps inside the window) still writes its
+        # trace out instead of vanishing with the process
+        self._stop_profile(None)
         self.watchdog.stop()
         if self.async_ckpt is not None:
             self.async_ckpt.stop()
@@ -137,6 +167,17 @@ class ResilienceContext:
 
     def before_step(self, trainer, step: int) -> None:
         self.watchdog.beat(step)
+        if self.recorder is not None:
+            self.recorder.step = step  # cheap attribute stamp, no I/O
+        # profile@K[:steps=N] trigger (obs): stop first — a bracket
+        # ending at THIS boundary must close before a new one opens —
+        # then start, so the jax.profiler trace covers exactly the
+        # steps [K, K+N) that run after this seam
+        if self._profile_stop_at is not None and step >= self._profile_stop_at:
+            self._stop_profile(step)
+        spec = self.plan.fire("profile", step)
+        if spec is not None:
+            self._start_profile(step, spec)
         spec = self.plan.fire("slowstep", step)
         if spec is not None:
             dur = 1.0 if spec.value is None else spec.value
@@ -150,13 +191,22 @@ class ResilienceContext:
         if spec is not None:
             self.log(f"FAULT: crash@{step} — raising InjectedCrash")
             raise InjectedCrash(f"injected crash@{step}")
-        requested = self.preemption.requested
+        local = self.preemption.requested
+        requested = local
         if self.cfg.coordinate_preemption and self._nprocs > 1:
             # coordinated drain (resilience/coord.py): fold every
             # host's flag into a cross-host OR at this boundary — one
             # tiny allgather riding the loop's existing sync cadence —
             # so any host's SIGTERM drains EVERY host at THIS step
             requested = coord.preemption_barrier(requested)
+            if requested and self.recorder is not None:
+                # the barrier outcome, per rank: `local` tells a
+                # post-mortem which host was actually signalled and
+                # which learned of it through the OR
+                self.recorder.event(
+                    "drain_barrier", step=step,
+                    local=bool(local), cluster=True,
+                )
             if requested and not self.preemption.requested:
                 self.preemption.trigger(
                     "coordinated drain (a peer host was preempted)"
@@ -168,6 +218,9 @@ class ResilienceContext:
         """Write the final checkpoint and leave with resumable status.
         Called at a step boundary, so nothing is in flight — the current
         step/chunk has fully drained."""
+        # close any open profiler bracket first: the trace must land on
+        # disk before the process exits 75
+        self._stop_profile(step)
         path = None
         if self.cfg.preemption_checkpoint:
             path = trainer.save(step)
@@ -183,6 +236,14 @@ class ResilienceContext:
             f"PREEMPTION: {self.preemption.reason} — drained at "
             f"step {step}{where}; exiting resumable"
         )
+        if self.recorder is not None:
+            self.recorder.event(
+                "drain", step=step,
+                reason=self.preemption.reason, checkpoint=path,
+            )
+            # the process is about to exit — the drain record must not
+            # die in the buffer
+            self.recorder.flush()
         raise PreemptionDrained(step, path)
 
     def after_step(self, trainer, step: int) -> int:
@@ -234,6 +295,11 @@ class ResilienceContext:
                 "but no checkpoint to roll back to — resetting the "
                 f"counter and backing the LR scale off to {new_scale:g}"
             )
+            if self.recorder is not None:
+                self.recorder.event(
+                    "guard_rollback", step=step, consecutive_bad=consec,
+                    checkpoint=None, lr_scale=new_scale,
+                )
             trainer.set_guard_state(consec=0, lr_scale=new_scale)
             return step
         self.log(
@@ -242,6 +308,15 @@ class ResilienceContext:
         )
         rolled = trainer.rollback_to(path)
         self.rollbacks += 1
+        if self.recorder is not None:
+            # verdict detail: what tripped (consecutive non-finite
+            # steps), where training rewound to, the compounded backoff
+            self.recorder.event(
+                "guard_rollback", step=step, consecutive_bad=consec,
+                checkpoint=path, resumed_step=rolled, lr_scale=new_scale,
+                rollbacks=self.rollbacks,
+            )
+            self.recorder.flush()
         trainer.set_guard_state(consec=0, lr_scale=new_scale)
         # re-arm the window from the rollback point so the next check
         # happens a full window after training resumes
@@ -274,11 +349,81 @@ class ResilienceContext:
         return out
 
     # ------------------------------------------------------------------
+    # profiler trigger (profile@K[:steps=N] — obs plane)
+    # ------------------------------------------------------------------
+
+    def _start_profile(self, step: int, spec) -> None:
+        """Open a jax.profiler bracket over steps [step, step+N). Rides
+        the fault-plan plumbing, so it is fire-once, rank-targetable,
+        and forces the per-step boundaries that make the bracket
+        exact. Degrades to a logged no-op when the profiler (or a
+        workspace to write into) is unavailable."""
+        if self._profiling:
+            self.log(
+                f"PROFILE: trigger at step {step} ignored — a trace is "
+                "already running"
+            )
+            return
+        if not self._profile_dir:
+            self.log(
+                f"PROFILE: trigger at step {step} ignored — no "
+                "workspace configured for the trace directory"
+            )
+            return
+        nsteps = spec.steps if spec.steps is not None else 1
+        try:
+            import jax.profiler
+
+            os.makedirs(self._profile_dir, exist_ok=True)
+            jax.profiler.start_trace(self._profile_dir)
+        except Exception as e:  # profiler availability is host-dependent
+            self.log(
+                f"PROFILE: could not start jax.profiler trace "
+                f"({type(e).__name__}: {e}) — continuing unprofiled"
+            )
+            return
+        self._profiling = True
+        self._profile_stop_at = step + nsteps
+        self.log(
+            f"PROFILE: tracing steps [{step}, {step + nsteps}) -> "
+            f"{self._profile_dir}"
+        )
+        if self.recorder is not None:
+            self.recorder.event(
+                "profile_start", step=step,
+                stop_at=step + nsteps, dir=self._profile_dir,
+            )
+
+    def _stop_profile(self, step: int | None) -> None:
+        """Close the open bracket (if any); ``step=None`` marks a
+        lifecycle close (drain / run end) rather than the scheduled
+        boundary."""
+        self._profile_stop_at = None
+        if not self._profiling:
+            return
+        self._profiling = False
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.log(
+                f"PROFILE: stop_trace failed ({type(e).__name__}: {e})"
+            )
+            return
+        where = f"at step {step}" if step is not None else "at shutdown"
+        self.log(f"PROFILE: trace stopped {where} -> {self._profile_dir}")
+        if self.recorder is not None:
+            self.recorder.event(
+                "profile_stop", step=step, dir=self._profile_dir,
+            )
+
+    # ------------------------------------------------------------------
     # checkpoint hook
     # ------------------------------------------------------------------
 
     def checkpoint_written(self, trainer, path: str, step: int) -> None:
-        del trainer, step
+        del trainer
         self.save_ordinal += 1
         spec = self.plan.fire("corrupt_ckpt", self.save_ordinal)
         if spec is not None:
@@ -286,6 +431,18 @@ class ResilienceContext:
             self.log(
                 f"FAULT: corrupt_ckpt@{self.save_ordinal} — tore {path}"
             )
+        rec = self.recorder
+        if rec is not None:
+            # every rank records its own write (async path: from the
+            # writer thread — the recorder is thread-safe); for sharded
+            # saves the rank's commit marker (phase 1 of the two-phase
+            # commit) is already on disk at this point
+            payload = {"path": path, "ordinal": self.save_ordinal}
+            if os.path.isdir(path):
+                payload["commit_marker"] = os.path.exists(
+                    coord.commit_marker_path(path, coord.process_index())
+                )
+            rec.event("ckpt_written", step=step, **payload)
         # validation, LATEST, and retention are process 0's job alone:
         # every process racing rmtree/marker writes on the same dir
         # would be chaos. For sharded saves, promotion is the second
@@ -300,14 +457,23 @@ class ResilienceContext:
             committed = coord.await_commits(
                 path, timeout=self.cfg.commit_timeout_s, log=self.log
             )
+            if rec is not None:
+                rec.event(
+                    "ckpt_commit", step=step, path=path,
+                    ok=bool(committed),
+                )
         folder = os.path.dirname(path)
         if committed and retention.validate_checkpoint(path):
             retention.mark_latest(folder, path)
+            if rec is not None:
+                rec.event("ckpt_latest", step=step, path=path)
         else:
             self.log(
                 f"WARNING: checkpoint {path} failed validation — "
                 "LATEST keeps pointing at the previous complete save"
             )
+            if rec is not None:
+                rec.event("ckpt_invalid", step=step, path=path)
         if self.cfg.keep_last > 0:
             for gone in retention.apply_retention(folder, self.cfg.keep_last):
                 self.log(f"retention: removed {gone}")

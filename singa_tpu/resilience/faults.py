@@ -21,6 +21,13 @@ from the ``-faults`` CLI flag or the ``SINGA_TPU_FAULTS`` env var:
                    Exercises the zero-stall pipeline's crash safety
                    (resilience/async_ckpt.py): LATEST must keep naming
                    the previous complete save
+  profile@20:steps=5  not a fault at all — the profiler TRIGGER rides
+                   the same plumbing (step-keyed, fire-once, rank-
+                   targetable, forces per-step boundaries): bracket
+                   steps 20..25 with jax.profiler.start_trace/stop_trace
+                   into <workspace>/xprof so per-op attribution is one
+                   config knob away. ``steps`` defaults to 1 and is only
+                   meaningful on profile terms.
 
 A ``:rank=K`` qualifier scopes a term to ONE process of a multi-process
 job — ``sigterm@12:rank=0`` preempts only rank 0 (its peers learn of it
@@ -73,10 +80,11 @@ KINDS = (
     "corrupt_ckpt",
     "slowstep",
     "async_torn_write",
+    "profile",
 )
 
 #: kinds triggered by step number at the pre-step boundary seam
-STEP_KINDS = ("crash", "sigterm", "slowstep")
+STEP_KINDS = ("crash", "sigterm", "slowstep", "profile")
 
 
 def tear_file(path: str) -> None:
@@ -97,19 +105,22 @@ def tear_file(path: str) -> None:
 
 @dataclasses.dataclass
 class FaultSpec:
-    """One ``kind@at[=value][:rank=K]`` term; ``fired`` flips on
-    injection. ``rank=None`` means every process."""
+    """One ``kind@at[=value][:steps=N][:rank=K]`` term; ``fired`` flips
+    on injection. ``rank=None`` means every process; ``steps`` is the
+    profile trigger's bracket length (None elsewhere)."""
 
     kind: str
     at: int
     value: float | None = None
     rank: int | None = None
+    steps: int | None = None
     fired: bool = False
 
     def __str__(self) -> str:
         v = "" if self.value is None else f"={self.value:g}"
+        s = "" if self.steps is None else f":steps={self.steps}"
         r = "" if self.rank is None else f":rank={self.rank}"
-        return f"{self.kind}@{self.at}{v}{r}"
+        return f"{self.kind}@{self.at}{v}{s}{r}"
 
 
 class FaultPlan:
@@ -117,6 +128,10 @@ class FaultPlan:
 
     def __init__(self, specs: list[FaultSpec] | None = None):
         self.specs = list(specs or [])
+        #: flight recorder (obs/recorder.py) — the supervisor wires it
+        #: so EVERY firing becomes a telemetry event, no matter which
+        #: seam fired it (step boundary, batch poisoning, writer thread)
+        self.recorder = None
 
     @classmethod
     def parse(cls, text: str | None) -> "FaultPlan":
@@ -125,28 +140,37 @@ class FaultPlan:
             term = term.strip()
             if not term:
                 continue
-            # the rank qualifier splits off first: values are plain
-            # floats, so the first ':' can only start ":rank=K"
-            body, sep_r, qual = term.partition(":")
+            # qualifiers split off first: values are plain floats, so
+            # every ':' starts a ":key=val" qualifier (rank=K, steps=N)
+            body, *quals = term.split(":")
             rank = None
-            if sep_r:
+            steps = None
+            for qual in quals:
                 qkey, qsep, qval = qual.partition("=")
-                if qkey != "rank" or not qsep:
+                if qkey not in ("rank", "steps") or not qsep:
                     raise FaultPlanError(
                         f"fault term {term!r}: unknown qualifier "
-                        f"{qual!r} (expected ':rank=K')"
+                        f"{qual!r} (expected ':rank=K' or ':steps=N')"
                     )
                 try:
-                    rank = int(qval)
+                    qint = int(qval)
                 except ValueError:
                     raise FaultPlanError(
-                        f"fault term {term!r}: rank {qval!r} is not an "
-                        "integer"
+                        f"fault term {term!r}: {qkey} {qval!r} is not "
+                        "an integer"
                     ) from None
-                if rank < 0:
-                    raise FaultPlanError(
-                        f"fault term {term!r}: negative rank"
-                    )
+                if qkey == "rank":
+                    if qint < 0:
+                        raise FaultPlanError(
+                            f"fault term {term!r}: negative rank"
+                        )
+                    rank = qint
+                else:
+                    if qint < 1:
+                        raise FaultPlanError(
+                            f"fault term {term!r}: steps must be >= 1"
+                        )
+                    steps = qint
             head, sep, val = body.partition("=")
             kind, sep2, at = head.partition("@")
             if not sep2:
@@ -174,7 +198,12 @@ class FaultPlan:
                     raise FaultPlanError(
                         f"fault term {term!r}: value {val!r} is not a number"
                     ) from None
-            specs.append(FaultSpec(kind, at_n, value, rank))
+            if steps is not None and kind != "profile":
+                raise FaultPlanError(
+                    f"fault term {term!r}: ':steps=N' only applies to "
+                    "profile triggers"
+                )
+            specs.append(FaultSpec(kind, at_n, value, rank, steps))
         return cls(specs)
 
     def __bool__(self) -> bool:
@@ -192,6 +221,20 @@ class FaultPlan:
             if spec.rank is not None and spec.rank != _process_index():
                 continue
             spec.fired = True
+            # profile is documented as NOT a fault — it gets its own
+            # profile_start/profile_stop events (context.py), and must
+            # not inflate a trace summary's fired-fault count
+            if self.recorder is not None and kind != "profile":
+                # corrupt_ckpt/async_torn_write key on save ORDINALS,
+                # not steps — those events inherit the last stamped step
+                step_keyed = kind in STEP_KINDS or kind == "nanloss"
+                self.recorder.event(
+                    "fault",
+                    step=at if step_keyed else None,
+                    fault=str(spec),
+                    fault_kind=kind,
+                    at=at,
+                )
             return spec
         return None
 

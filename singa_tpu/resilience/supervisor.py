@@ -43,6 +43,26 @@ def _banner(trainer, model_cfg: ModelConfig) -> None:
     )
 
 
+def _record_start(rec, trainer, model_cfg, attempt: int, resumed) -> None:
+    """The run_start event: run identity (run id = config hash), where
+    this attempt begins, and the topology a post-mortem needs."""
+    from .coord import process_count
+
+    rec.event(
+        "run_start",
+        step=trainer.start_step,
+        attempt=attempt,
+        name=model_cfg.name,
+        train_steps=model_cfg.train_steps,
+        batch=trainer.train_net.batchsize,
+        mesh={k: int(v) for k, v in dict(trainer.mesh.shape).items()},
+        nprocs=process_count(),
+        pid=os.getpid(),
+        resumed_from=resumed,
+    )
+    rec.flush()
+
+
 def run(
     model_cfg: ModelConfig,
     cluster_cfg=None,
@@ -63,6 +83,13 @@ def run(
     )
     trainer_kwargs.setdefault("log", log)
 
+    # flight recorder (singa_tpu/obs/): always-on when the job has a
+    # workspace to write into; one recorder spans every restart attempt
+    # so the per-rank event log is the whole job's story
+    from ..obs.recorder import recorder_for_job
+
+    rec = recorder_for_job(model_cfg, cluster_cfg, log=log)
+
     res = model_cfg.resilience
     if res is None and not plan:
         # unsupervised jobs keep their exact pre-supervisor behavior
@@ -70,12 +97,30 @@ def run(
             model_cfg, cluster_cfg, seed=seed, **trainer_kwargs
         )
         _banner(trainer, model_cfg)
-        trainer.run()
-        return EXIT_OK
+        if rec is None:
+            trainer.run()
+            return EXIT_OK
+        trainer.attach_telemetry(rec)
+        _record_start(rec, trainer, model_cfg, attempt=1, resumed=None)
+        try:
+            trainer.run()
+            rec.event(
+                "run_stop", step=model_cfg.train_steps,
+                status="ok", exit_code=EXIT_OK,
+            )
+            return EXIT_OK
+        except BaseException as e:
+            rec.event(
+                "run_stop", status="crashed",
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise
+        finally:
+            rec.close()
 
     if res is None:
         res = ResilienceConfig()
-    ctx = ResilienceContext(res, plan, log=log)
+    ctx = ResilienceContext(res, plan, log=log, recorder=rec)
     if not ctx.preemption.install():
         log(
             "resilience: cannot install signal handlers (not the main "
@@ -114,6 +159,11 @@ def run(
                 )
                 ctx.bind(trainer)
                 _banner(trainer, model_cfg)
+                if rec is not None:
+                    _record_start(
+                        rec, trainer, model_cfg,
+                        attempt=attempt, resumed=latest,
+                    )
                 trainer.run()
                 # the end-of-run checkpoint must be durable before the
                 # job reports success (raises if the write failed)
@@ -125,6 +175,11 @@ def run(
                 # deliberate exit: peers must not read our now-frozen
                 # heartbeat as a death (watchdog.py done sentinel)
                 ctx.mark_done()
+                if rec is not None:
+                    rec.event(
+                        "run_stop", step=model_cfg.train_steps,
+                        status="ok", exit_code=EXIT_OK, attempt=attempt,
+                    )
                 return EXIT_OK
             except PreemptionDrained as e:
                 log(
@@ -135,6 +190,14 @@ def run(
                     # every rank drained at this same step (or there is
                     # only one) — a deliberate exit, not a death
                     ctx.mark_done()
+                if rec is not None:
+                    # the exit-75 record post-mortems key on: this rank
+                    # left deliberately, resumable, at this step
+                    rec.event(
+                        "run_stop", step=e.step, status="preempted",
+                        exit_code=EXIT_RESUMABLE, attempt=attempt,
+                        checkpoint=e.checkpoint,
+                    )
                 return EXIT_RESUMABLE
             except GuardGaveUp as e:
                 # a deterministic divergence replays identically after
@@ -145,6 +208,11 @@ def run(
                     f"supervisor: GIVING UP — divergence guard declared "
                     f"the failure unrecoverable ({e}); not restarting"
                 )
+                if rec is not None:
+                    rec.event(
+                        "run_stop", status="guard_gave_up",
+                        attempt=attempt, error=str(e),
+                    )
                 raise
             except Exception as e:  # the supervisor survives ANY crash
                 start = trainer.start_step if trainer is not None else 0
@@ -163,6 +231,12 @@ def run(
                     f"({type(e).__name__}: {e}); {progress} step(s) of "
                     "progress since restore"
                 )
+                if rec is not None:
+                    rec.event(
+                        "crash", step=done, attempt=attempt,
+                        error=f"{type(e).__name__}: {e}",
+                        progress=progress,
+                    )
                 from .coord import process_count
 
                 if process_count() > 1:
@@ -180,6 +254,11 @@ def run(
                         f"desync); exiting resumable ({EXIT_RESUMABLE}) "
                         "so the launcher restarts all ranks together"
                     )
+                    if rec is not None:
+                        rec.event(
+                            "run_stop", step=done, status="crashed",
+                            exit_code=EXIT_RESUMABLE, attempt=attempt,
+                        )
                     return EXIT_RESUMABLE
                 if failures > res.max_restarts:
                     log(
@@ -188,6 +267,11 @@ def run(
                         f"{window} step(s) of progress "
                         f"(max_restarts {res.max_restarts}); re-raising"
                     )
+                    if rec is not None:
+                        rec.event(
+                            "run_stop", step=done, status="gave_up",
+                            attempt=attempt, failures=failures,
+                        )
                     raise
                 delay = min(
                     res.backoff_max,
@@ -197,9 +281,20 @@ def run(
                     f"supervisor: restart {failures}/{res.max_restarts} "
                     f"in {delay:g}s"
                 )
+                if rec is not None:
+                    # restart with cause and backoff — flushed now: the
+                    # next attempt may die before its display cadence
+                    rec.event(
+                        "restart", step=done, attempt=attempt,
+                        failures=failures, backoff_s=delay,
+                        cause=f"{type(e).__name__}: {e}",
+                    )
+                    rec.flush()
                 if delay > 0:
                     time.sleep(delay)
     finally:
         ctx.stop()
         ctx.preemption.uninstall()
         model_cfg.checkpoint = configured_ckpt
+        if rec is not None:
+            rec.close()

@@ -50,6 +50,11 @@ class Watchdog:
     def __init__(self, timeout: float, log=print):
         self.timeout = float(timeout)
         self.log = log
+        #: flight recorder (obs/recorder.py): stall dumps and peer-death
+        #: verdicts were stderr-only — as events they survive into the
+        #: post-mortem trace even when nobody captured the process's
+        #: stderr. None = telemetry off.
+        self.recorder = None
         self._lock = threading.Lock()
         self._last_beat = time.monotonic()
         self._last_step = -1
@@ -215,6 +220,15 @@ class Watchdog:
             if deliberate:
                 continue  # deliberate exit (trained / coordinated drain)
             self.dead_peers.add(k)
+            if self.recorder is not None:
+                # recorded (and flushed) BEFORE the verdict callback:
+                # the default callback is os._exit(75), which would
+                # otherwise take the buffered verdict down with it
+                self.recorder.event(
+                    "peer_death", peer=k, stale_s=round(age, 3),
+                    deadline_s=hb["timeout"],
+                )
+                self.recorder.flush()
             hb["on_dead"](k, age)
 
     def _exit_peer_dead(self, rank: int, age: float) -> None:
@@ -245,3 +259,14 @@ class Watchdog:
             lines.append(f"--- thread {names.get(ident, ident)} ---")
             lines.append("".join(traceback.format_stack(frame)).rstrip())
         self.log("\n".join(lines))
+        if self.recorder is not None:
+            # the full dump rides the event (bounded — a pathological
+            # thread count must not bloat the log past usefulness);
+            # flushed now because a stalled run may never reach its
+            # next display boundary
+            self.recorder.event(
+                "watchdog_stall", step=step,
+                elapsed_s=round(elapsed, 3), timeout_s=self.timeout,
+                stacks="\n".join(lines[1:])[:16384],
+            )
+            self.recorder.flush()

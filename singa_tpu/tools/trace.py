@@ -1,0 +1,325 @@
+"""Merge per-rank flight-recorder logs into one Chrome trace + summary.
+
+The flight recorder (singa_tpu/obs/) leaves one JSONL event log per
+rank in ``<workspace>/events/``. This tool is the post-mortem view of a
+multi-host incident:
+
+  merge (default)   fold every ``rank_k.jsonl`` into ONE Perfetto-
+      loadable ``trace.json``: span records become 'X' duration events
+      (pid = rank, tid = track: phases / feeder / stager / ckpt_writer),
+      lifecycle events become instant events on each rank's 'events'
+      thread. Ranks share no monotonic epoch, so the merge aligns on
+      wall clock (each record carries both).
+
+  --summarize       one JSON report instead: step-time p50/p99 (from
+      train spans, normalized per step), input/ckpt stall shares,
+      guard/fault/restart counts, checkpoint commit outcomes, and
+      per-rank skew (max wall-clock spread of the same display step /
+      drain barrier across ranks).
+
+Usage::
+
+  python -m singa_tpu.tools.trace <workspace-or-events-dir> [-o trace.json]
+  python -m singa_tpu.tools.trace <workspace-or-events-dir> --summarize
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _events_dir(path: str) -> str:
+    """Accept the workspace, its events subdir, or any dir holding
+    rank_*.jsonl files."""
+    for cand in (os.path.join(path, "events"), path):
+        if glob.glob(os.path.join(cand, "rank_*.jsonl")):
+            return cand
+    raise FileNotFoundError(
+        f"no rank_*.jsonl event logs under {path!r} (or {path!r}/events)"
+    )
+
+
+def load_events(path: str) -> tuple[list[dict], int]:
+    """-> (records sorted by wall time, unparseable-line count). A torn
+    tail line (the process died mid-append) is skipped, not fatal —
+    that is exactly the situation a post-mortem runs in."""
+    records: list[dict] = []
+    skipped = 0
+    for fn in sorted(glob.glob(os.path.join(_events_dir(path), "rank_*.jsonl"))):
+        with open(fn, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if isinstance(rec, dict) and "ts" in rec:
+                    records.append(rec)
+                else:
+                    skipped += 1
+    records.sort(key=lambda r: r["ts"])
+    return records, skipped
+
+
+# ---------------------------------------------------------------------------
+# merge -> Chrome trace
+# ---------------------------------------------------------------------------
+
+#: stable tid assignment per track so the Perfetto lanes sort usefully
+_TRACK_TIDS = {
+    "phases": 1,
+    "feeder": 2,
+    "stager": 3,
+    "ckpt_writer": 4,
+    "events": 9,
+}
+
+
+def to_chrome_trace(records: list[dict]) -> dict:
+    """-> the Chrome-trace JSON object ({"traceEvents": [...]})."""
+    if not records:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(r["ts"] for r in records)
+    events: list[dict] = []
+    seen_threads: set[tuple[int, int]] = set()
+    ranks: set[int] = set()
+
+    def tid_for(track: str) -> int:
+        return _TRACK_TIDS.get(track, 8)
+
+    for r in records:
+        rank = int(r.get("rank", 0))
+        ranks.add(rank)
+        ts_us = (r["ts"] - t0) * 1e6
+        if r.get("kind") == "span":
+            track = r.get("track", "phases")
+            tid = tid_for(track)
+            args = {"step": r.get("step")}
+            if "steps" in r:
+                args["steps"] = r["steps"]
+            events.append({
+                "name": r.get("name", "span"),
+                "cat": track,
+                "ph": "X",
+                "ts": ts_us,
+                "dur": max(0.0, float(r.get("dur", 0.0))) * 1e6,
+                "pid": rank,
+                "tid": tid,
+                "args": args,
+            })
+        else:
+            track, tid = "events", _TRACK_TIDS["events"]
+            args = {"step": r.get("step")}
+            args.update(r.get("data", {}))
+            events.append({
+                "name": r.get("kind", "event"),
+                "cat": "lifecycle",
+                "ph": "i",
+                "s": "t",  # thread-scoped instant marker
+                "ts": ts_us,
+                "pid": rank,
+                "tid": tid,
+                "args": args,
+            })
+        seen_threads.add((rank, tid))
+
+    meta: list[dict] = []
+    for rank in sorted(ranks):
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+            "args": {"name": f"rank {rank}"},
+        })
+    names = {tid: track for track, tid in _TRACK_TIDS.items()}
+    for rank, tid in sorted(seen_threads):
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
+            "args": {"name": names.get(tid, "other")},
+        })
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {"wall_epoch_s": t0},
+    }
+
+
+# ---------------------------------------------------------------------------
+# summarize
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def summarize(records: list[dict]) -> dict:
+    """The incident report: rates, stall shares, lifecycle counts,
+    per-rank skew."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    life = [r for r in records if r.get("kind") != "span"]
+
+    # step-time percentiles: each train span covers `steps` steps; its
+    # per-step time repeats with that weight so chunked windows don't
+    # undercount relative to per-step dispatch
+    per_step_ms: list[float] = []
+    phase_totals: dict[str, float] = {}
+    for s in spans:
+        if s.get("track") != "phases":
+            phase_totals[s.get("track", "?")] = (
+                phase_totals.get(s.get("track", "?"), 0.0) + s.get("dur", 0.0)
+            )
+            continue
+        name = s.get("name", "?")
+        dur = float(s.get("dur", 0.0))
+        phase_totals[name] = phase_totals.get(name, 0.0) + dur
+        if name == "train":
+            n = max(1, int(s.get("steps", 1)))
+            per_step_ms.extend([dur / n * 1e3] * min(n, 4096))
+    per_step_ms.sort()
+
+    train_t = phase_totals.get("train", 0.0)
+    data_t = phase_totals.get("data", 0.0)
+    ckpt_t = phase_totals.get("ckpt", 0.0)
+    step_path = train_t + data_t + ckpt_t
+
+    counts: dict[str, int] = {}
+    for r in life:
+        counts[r.get("kind", "?")] = counts.get(r.get("kind", "?"), 0) + 1
+
+    by_rank: dict[int, int] = {}
+    for r in records:
+        by_rank[int(r.get("rank", 0))] = (
+            by_rank.get(int(r.get("rank", 0)), 0) + 1
+        )
+
+    # per-rank skew: the same display step / drain barrier seen on
+    # multiple ranks should land at (nearly) the same wall instant —
+    # the max spread is the cross-rank lag a post-mortem cares about
+    skew = 0.0
+    for kind in ("step", "drain_barrier"):
+        marks: dict[int, dict[int, float]] = {}
+        for r in life:
+            if r.get("kind") != kind or r.get("step") is None:
+                continue
+            marks.setdefault(int(r["step"]), {})[int(r.get("rank", 0))] = (
+                r["ts"]
+            )
+        for ts_by_rank in marks.values():
+            if len(ts_by_rank) > 1:
+                skew = max(
+                    skew, max(ts_by_rank.values()) - min(ts_by_rank.values())
+                )
+
+    faults = [
+        r["data"].get("fault")
+        for r in life
+        if r.get("kind") == "fault" and isinstance(r.get("data"), dict)
+    ]
+    guard_rollbacks = counts.get("guard_rollback", 0)
+    last_steps = [
+        r for r in life if r.get("kind") == "step"
+    ]
+    steps_per_s = [
+        r["data"]["steps_per_s"]
+        for r in last_steps
+        if isinstance(r.get("data"), dict) and "steps_per_s" in r["data"]
+    ]
+
+    return {
+        "records": len(records),
+        "ranks": {str(k): v for k, v in sorted(by_rank.items())},
+        "step_time_ms": {
+            "p50": round(_percentile(per_step_ms, 0.50), 3),
+            "p99": round(_percentile(per_step_ms, 0.99), 3),
+            "n": len(per_step_ms),
+        },
+        "steps_per_s": {
+            "mean": round(sum(steps_per_s) / len(steps_per_s), 3)
+            if steps_per_s
+            else None,
+            "windows": len(steps_per_s),
+        },
+        "stall_shares": {
+            "input": round(data_t / step_path, 4) if step_path > 0 else 0.0,
+            "ckpt": round(ckpt_t / step_path, 4) if step_path > 0 else 0.0,
+        },
+        "counts": {
+            "faults": len(faults),
+            "guard_rollbacks": guard_rollbacks,
+            "restarts": counts.get("restart", 0),
+            "crashes": counts.get("crash", 0),
+            "drains": counts.get("drain", 0),
+            "peer_deaths": counts.get("peer_death", 0),
+            "watchdog_stalls": counts.get("watchdog_stall", 0),
+            "checkpoints_written": counts.get("ckpt_written", 0),
+            "latest_promotions": counts.get("ckpt_latest", 0),
+            "torn_commits": sum(
+                1
+                for r in life
+                if r.get("kind") == "ckpt_commit"
+                and isinstance(r.get("data"), dict)
+                and not r["data"].get("ok", True)
+            ),
+        },
+        "fired_faults": faults,
+        "max_rank_skew_s": round(skew, 4),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="trace", description=__doc__)
+    ap.add_argument(
+        "path", help="workspace (or its events/ dir) holding rank_*.jsonl"
+    )
+    ap.add_argument(
+        "-o", "--output", default=None,
+        help="merged Chrome-trace output (default: <path>/trace.json)",
+    )
+    ap.add_argument(
+        "--summarize", action="store_true",
+        help="print the incident summary JSON instead of merging",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        records, skipped = load_events(args.path)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if skipped:
+        print(
+            f"trace: skipped {skipped} unparseable line(s) "
+            "(torn tail from a dead process?)",
+            file=sys.stderr,
+        )
+    if args.summarize:
+        print(json.dumps(summarize(records), indent=2))
+        return 0
+    trace = to_chrome_trace(records)
+    out = args.output or os.path.join(args.path, "trace.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    os.replace(tmp, out)
+    print(
+        json.dumps({
+            "trace": out,
+            "events": len(trace["traceEvents"]),
+            "records": len(records),
+            "skipped": skipped,
+        })
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,11 @@ class Trainer:
         # --- resilience seams (resilience/context.py): the supervisor
         # (or a test) attaches a ResilienceContext; None = inert ---
         self.resilience = None
+        # --- telemetry (singa_tpu/obs/): the flight recorder the
+        # supervisor attaches via attach_telemetry; None = inert. The
+        # step path never writes or syncs for it — events buffer in
+        # memory and flush at display cadence (_post_events) ---
+        self.telemetry = None
         # every engine supports the guard through the shared _step_core
         # seam (resilience/guard.py guarded_step): each core reports
         # its own finiteness verdict, the wrapper applies the policy
@@ -259,6 +264,28 @@ class Trainer:
         #: unpad? -> compiled snapshot program (zero-stall checkpointing)
         self._snapshot_fns: dict[bool, Callable] = {}
         self._batch_size = self.train_net.batchsize
+        #: tokens consumed per train step (LM configs: kSequenceData
+        #: feeds (B, S) token batches) — 0 for non-token workloads.
+        #: Drives the display line's tok/s readout, straight from the
+        #: existing Timers accumulators, no new host syncs.
+        self._tokens_per_step = sum(
+            l.batchsize * int(np.prod(l.sample_shape)) * self._batches_per_step
+            for l in self.train_net.datalayers
+            if getattr(l, "TYPE", "") == "kSequenceData"
+        )
+
+    # ------------------------------------------------------------------
+    # telemetry (singa_tpu/obs/recorder.py)
+    # ------------------------------------------------------------------
+
+    def attach_telemetry(self, rec) -> None:
+        """Wire the flight recorder in: lifecycle events from the
+        cadence loop, and (span mode) every timed phase occurrence as a
+        Chrome-trace span. Purely host-side buffer appends — the step
+        path gains no write syscalls and no device syncs."""
+        self.telemetry = rec
+        if rec is not None:
+            self.timers.span_sink = rec.phase_span
 
     # ------------------------------------------------------------------
     # param materialization (overridden by ReplicaTrainer)
@@ -719,9 +746,16 @@ class Trainer:
                     for name, pipe in pipes.items()
                 }
 
-            self._feeder = DeviceFeeder(
-                lambda: self._assemble_host_batch(net), positions
-            )
+            def assemble():
+                # feeder-thread span (obs/): assembly + device_put of
+                # the read-ahead batch becomes its own trace track
+                rec = self.telemetry
+                if rec is None:
+                    return self._assemble_host_batch(net)
+                with rec.span("assemble_batch", track="feeder"):
+                    return self._assemble_host_batch(net)
+
+            self._feeder = DeviceFeeder(assemble, positions)
         return self._feeder
 
     def _chunk_stager(self):
@@ -746,6 +780,15 @@ class Trainer:
                 if arr.dtype != orig:
                     self._cache_cast[(id(net), name)] = jnp.dtype(orig)
                 sources[name] = (arr, pipe.labels, pipe.batchsize)
+            def put(a):
+                # stager-thread span (obs/): each staged block's
+                # host->device commit becomes its own trace track
+                rec = self.telemetry
+                if rec is None:
+                    return jax.device_put(jnp.asarray(a), self._repl)
+                with rec.span("stage_block", track="stager"):
+                    return jax.device_put(jnp.asarray(a), self._repl)
+
             self._stager = ChunkStager(
                 sources,
                 self._batches_per_step,
@@ -753,7 +796,7 @@ class Trainer:
                 cursors=lambda: {
                     name: pipe.position for name, pipe in pipes.items()
                 },
-                put=lambda a: jax.device_put(jnp.asarray(a), self._repl),
+                put=put,
             )
         return self._stager
 
@@ -811,6 +854,8 @@ class Trainer:
 
     def train_one_batch(self, step: int) -> None:
         """TrainOneBatch (worker.cc:304-316): one forward+backward+update."""
+        if self.telemetry is not None:
+            self.telemetry.step = step  # cheap attribute stamp, no I/O
         with self.timers.phase("data"):
             batch = self._next_batch(self.train_net)
         if self.resilience is not None:
@@ -946,7 +991,9 @@ class Trainer:
         the buffer swap)."""
         pipes = self._pipelines[id(self.train_net)]
         streaming = self.feeder_mode == "stream"
-        with self.timers.phase("data"):
+        if self.telemetry is not None:
+            self.telemetry.step = step0  # cheap attribute stamp, no I/O
+        with self.timers.phase("data", steps=nsteps):
             if streaming:
                 data, after = self._chunk_stager().take(step0, nsteps)
                 pos0s = {name: jnp.int32(0) for name in pipes}
@@ -956,7 +1003,7 @@ class Trainer:
                     for name, pipe in pipes.items()
                 }
                 data = self._dev_data[id(self.train_net)]
-        with self.timers.phase("train"):
+        with self.timers.phase("train", steps=nsteps):
             out = fn(
                 self.params, self.state, self.buffers, *extra_in,
                 jnp.int32(step0), pos0s, data,
@@ -1082,7 +1129,7 @@ class Trainer:
                 name: jnp.int32(pipe.position)
                 for name, pipe in pipes.items()
             }
-            with self.timers.phase("eval"):
+            with self.timers.phase("eval", steps=nsteps):
                 summed = self._eval_chunk_fns[key](
                     eval_params, eval_buffers, pos0s,
                     self._dev_data[id(net)],
@@ -1092,13 +1139,20 @@ class Trainer:
             perf.update_summed(summed, nsteps)
         else:
             fn = self._eval_step_for(net)
-            with self.timers.phase("eval"):
+            with self.timers.phase("eval", steps=nsteps):
                 for _ in range(nsteps):
                     perf.update(
                         fn(eval_params, eval_buffers, self._next_batch(net))
                     )
         avg = perf.avg()
         self.log(f"step {step}: {phase} {perf.to_string(avg)}")
+        if self.telemetry is not None:
+            # avg is already on host (computed for the display line) —
+            # the event reuses it, no second device round trip
+            self.telemetry.event(
+                "eval", step=step, phase=phase, batches=nsteps,
+                metrics={l: dict(b) for l, b in avg.items()},
+            )
         return avg
 
     def _pre_events(self, step: int) -> None:
@@ -1120,10 +1174,17 @@ class Trainer:
         """Display/checkpoint run AFTER the train step."""
         cfg = self.cfg
         if _now(step, cfg.display_frequency, cfg.display_after_steps):
-            sps = 0.0
+            sps = steps_s = 0.0
             t = self.timers.total("train") + self.timers.total("data")
             if t > 0:
                 sps = self.perf.count * self._batch_size / t
+                # steps/s (and tok/s for LM configs) straight from the
+                # existing accumulators — perf.count already counts the
+                # window's steps, no new host syncs
+                steps_s = self.perf.count / t
+            rate = f"{sps:.0f} samples/s, {steps_s:.1f} steps/s"
+            if self._tokens_per_step and steps_s > 0:
+                rate += f", {steps_s * self._tokens_per_step:.0f} tok/s"
             # input-stall readout (the guard-counter pattern): per-window
             # data time and its share of the step path, straight from the
             # timers' existing aggregation — no new per-step host syncs
@@ -1137,6 +1198,7 @@ class Trainer:
             # sync, at display cadence — never per step); rollbacks are
             # the context's count
             guard = ""
+            g = {}
             if self._guard is not None:
                 g = self.guard_counters()
                 rb = getattr(self.resilience, "rollbacks", 0)
@@ -1144,15 +1206,42 @@ class Trainer:
                     f" guard[bad {g['bad_steps']}, rollbacks {rb}, "
                     f"lr x{g['lr_scale']:g}]"
                 )
+            # metrics pulled ONCE (the display line's existing sync);
+            # the telemetry step record reuses the same host values
+            avg = self.perf.avg()
             self.log(
-                f"step {step}: train {self.perf.to_string()} "
-                f"[{self.timers.to_string()}; {sps:.0f} samples/s]"
+                f"step {step}: train {self.perf.to_string(avg)} "
+                f"[{self.timers.to_string()}; {rate}]"
                 f"{stall}{guard}"
             )
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "step",
+                    step=step,
+                    metrics={l: dict(b) for l, b in avg.items()},
+                    phase_ms={
+                        p: round(self.timers.mean_ms(p), 3)
+                        for p in self.timers.phases()
+                    },
+                    steps=self.perf.count,
+                    samples_per_s=round(sps, 1),
+                    steps_per_s=round(steps_s, 3),
+                    **(
+                        {"tokens_per_s": round(
+                            steps_s * self._tokens_per_step, 1
+                        )}
+                        if self._tokens_per_step
+                        else {}
+                    ),
+                    **({"guard": g} if g else {}),
+                )
             if cfg.debug:
                 self.log(self.debug_string(step))
             self.perf.reset()
             self.timers.reset()
+            if self.telemetry is not None:
+                # the cadence boundary is the ONLY step-loop flush point
+                self.telemetry.flush()
         # snapshot labels carry the RESUME step (steps completed), matching
         # the end-of-run save and restore_into's start_step contract — so a
         # resumed run never replays the step it saved after
@@ -1240,10 +1329,17 @@ class Trainer:
             return None
         ctx = self.resilience
         writer = ctx.async_ckpt if ctx is not None else None
+        rec = self.telemetry
         if writer is None:
-            path, write = self._prepare_save(folder, step, snapshot=False)
-            write()
+            # the ckpt phase times the save's step-path cost (sync: the
+            # whole serialize; async below: snapshot + submit only) —
+            # tools/trace.py's stall shares read it
+            with self.timers.phase("ckpt"):
+                path, write = self._prepare_save(folder, step, snapshot=False)
+                write()
             self.log(f"step {step}: checkpoint -> {path}")
+            if rec is not None:
+                rec.event("ckpt_save", step=step, path=path, mode="sync")
             if ctx is not None:
                 # corrupt_ckpt fault, completeness validation, LATEST
                 # marking, keep-last-N retention (resilience/retention.py)
@@ -1255,12 +1351,15 @@ class Trainer:
         # The step loop continues immediately; validation/LATEST/
         # retention run from the writer via the same checkpoint_written
         # seam, in submit (= step) order. ---
-        path, write = self._prepare_save(folder, step, snapshot=True)
-        writer.submit(
-            step, path, write,
-            on_written=lambda p, s: ctx.checkpoint_written(self, p, s),
-        )
+        with self.timers.phase("ckpt"):
+            path, write = self._prepare_save(folder, step, snapshot=True)
+            writer.submit(
+                step, path, write,
+                on_written=lambda p, s: ctx.checkpoint_written(self, p, s),
+            )
         self.log(f"step {step}: checkpoint (async) -> {path}")
+        if rec is not None:
+            rec.event("ckpt_save", step=step, path=path, mode="async")
         return path
 
     def _prepare_save(self, folder: str, step: int, snapshot: bool):

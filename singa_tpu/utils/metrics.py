@@ -36,7 +36,13 @@ class Performance:
     def update_summed(self, summed: dict[str, dict], nsteps: int) -> None:
         """Accumulate ``nsteps`` steps whose metrics are already summed
         on device (the chunk engine's lax.scan output reduced over its
-        step axis) — no per-step host transfer, same averages."""
+        step axis) — no per-step host transfer, same averages.
+
+        ``nsteps <= 0`` is a no-op: a zero-length window carries no
+        steps, so folding its sums in while netting the count to zero
+        would silently skew the next window's averages."""
+        if nsteps <= 0:
+            return
         self.update(summed)
         self._count += nsteps - 1
 

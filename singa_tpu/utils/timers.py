@@ -20,15 +20,28 @@ import time
 
 
 class Timers:
-    def __init__(self):
+    def __init__(self, span_sink=None):
+        #: span-recording mode (singa_tpu/obs/): when set, every phase
+        #: occurrence ALSO calls ``span_sink(name, t0_wall, dur, steps)``
+        #: — the flight recorder buffers it as a Chrome-trace span. The
+        #: sink must do no I/O and no device work (obs/recorder.py's
+        #: contract); ``reset()`` leaves it attached.
+        self.span_sink = span_sink
         self.reset()
 
     def reset(self) -> None:
         self._acc: dict[str, float] = {}
         self._n: dict[str, int] = {}
+        self._steps: dict[str, int] = {}
 
     @contextlib.contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, steps: int = 1):
+        """Time one occurrence of ``name``. ``steps`` is how many train
+        steps the occurrence covers (chunked dispatch windows pass the
+        window length) — feeds the per-STEP means and the span export;
+        accumulators are otherwise unchanged."""
+        sink = self.span_sink
+        t0w = time.time() if sink is not None else 0.0
         t0 = time.perf_counter()
         try:
             yield
@@ -36,6 +49,9 @@ class Timers:
             dt = time.perf_counter() - t0
             self._acc[name] = self._acc.get(name, 0.0) + dt
             self._n[name] = self._n.get(name, 0) + 1
+            self._steps[name] = self._steps.get(name, 0) + max(1, steps)
+            if sink is not None:
+                sink(name, t0w, dt, steps)
 
     def total(self, name: str) -> float:
         return self._acc.get(name, 0.0)
@@ -47,6 +63,11 @@ class Timers:
     def mean_ms(self, name: str) -> float:
         n = self._n.get(name, 0)
         return (self._acc.get(name, 0.0) / n * 1000.0) if n else 0.0
+
+    def steps(self, name: str) -> int:
+        """Train steps covered by ``name``'s occurrences (chunk windows
+        count their whole window — see ``phase(steps=)``)."""
+        return self._steps.get(name, 0)
 
     def share(self, name: str, *others: str) -> float:
         """``name``'s fraction of the time accumulated across ``name`` +

@@ -1,0 +1,107 @@
+"""Cross-rank telemetry: the 2-rank preemption drill leaves a mergeable
+flight record.
+
+The acceptance drill: ``sigterm@12:rank=0`` across two OS processes
+(the same real-CLI harness as test_mp_resilience) must yield per-rank
+JSONL event logs that ``tools/trace.py`` merges into ONE
+Perfetto-loadable ``trace.json`` reconstructing the coordinated drain
+end to end — both ranks' drain barrier, their shard writes with commit
+markers, rank 0's commit verdict + LATEST promotion, and both exit-75
+records, in order.
+"""
+
+import json
+import os
+
+import pytest
+
+from singa_tpu.tools import trace as trace_tool
+
+from test_mp_resilience import EXIT_RESUMABLE, _launch, _write_job
+
+
+@pytest.mark.slow
+def test_two_rank_drain_yields_mergeable_trace(tmp_path):
+    model_conf, cluster_conf, ck_dir = _write_job(
+        tmp_path, "tel", steps=20, heartbeat_s=30.0
+    )
+    ws = os.path.dirname(ck_dir)
+    results = _launch(
+        tmp_path, "tel", model_conf, cluster_conf,
+        faults="sigterm@12:rank=0",
+    )
+    for rank, (rc, log_text, _) in results.items():
+        assert rc == EXIT_RESUMABLE, f"rank {rank} rc={rc}\n{log_text}"
+
+    # --- per-rank event logs exist and reconstruct the drain in order
+    for rank in range(2):
+        ev = os.path.join(ws, "events", f"rank_{rank}.jsonl")
+        assert os.path.exists(ev), f"rank {rank} wrote no event log"
+        recs = [json.loads(l) for l in open(ev)]
+        assert all(r["rank"] == rank for r in recs)
+        kinds = [r["kind"] for r in recs]
+        # the drain story, in order: barrier -> the DRAIN save's shard
+        # write (with its commit marker) -> drain -> resumable exit
+        # (earlier cadence checkpoints precede the barrier; the index
+        # math below pins the step-12 sequence specifically)
+        for k in ("drain_barrier", "ckpt_written", "drain", "run_stop"):
+            assert k in kinds, f"rank {rank} missing {k}: {kinds}"
+        drain_write = next(
+            i for i, r in enumerate(recs)
+            if r["kind"] == "ckpt_written" and r["step"] == 12
+        )
+        assert (
+            kinds.index("drain_barrier")
+            < drain_write
+            < kinds.index("drain")
+            < kinds.index("run_stop")
+        )
+        barrier = next(r for r in recs if r["kind"] == "drain_barrier")
+        assert barrier["step"] == 12
+        # rank 0 was signalled; rank 1 learned through the OR
+        assert barrier["data"]["local"] is (rank == 0)
+        written = recs[drain_write]
+        assert written["data"]["path"].endswith("step_12.ckpt")
+        assert written["data"]["commit_marker"] is True
+        stop = [r for r in recs if r["kind"] == "run_stop"][-1]
+        assert stop["data"]["exit_code"] == 75
+        assert stop["data"]["status"] == "preempted"
+        assert stop["step"] == 12
+    # commit verdict + promotion are rank 0's
+    rank0 = [
+        json.loads(l)
+        for l in open(os.path.join(ws, "events", "rank_0.jsonl"))
+    ]
+    commit = next(r for r in rank0 if r["kind"] == "ckpt_commit")
+    assert commit["data"]["ok"] is True
+    assert any(r["kind"] == "ckpt_latest" for r in rank0)
+
+    # --- the merged trace is valid Chrome-trace JSON covering both ranks
+    out = str(tmp_path / "trace.json")
+    assert trace_tool.main([ws, "-o", out]) == 0
+    trace = json.load(open(out))
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    assert {e["pid"] for e in evs if e["ph"] != "M"} == {0, 1}
+    for e in evs:
+        assert e["ph"] in ("X", "i", "M")
+        if e["ph"] != "M":
+            assert e["ts"] >= 0.0
+    # both ranks' barrier + exit instants survive the merge, in wall
+    # order within each rank
+    for rank in range(2):
+        marks = [
+            e for e in evs
+            if e["ph"] == "i" and e["pid"] == rank
+            and e["name"] in ("drain_barrier", "run_stop")
+        ]
+        assert [m["name"] for m in marks] == ["drain_barrier", "run_stop"]
+        assert marks[0]["ts"] <= marks[1]["ts"]
+
+    # --- the summary reads the incident correctly
+    summary = trace_tool.summarize(trace_tool.load_events(ws)[0])
+    assert summary["counts"]["drains"] == 2
+    assert summary["counts"]["torn_commits"] == 0
+    # 3 saves (steps 5, 10, drain-12) x 2 ranks
+    assert summary["counts"]["checkpoints_written"] == 6
+    assert set(summary["ranks"]) == {"0", "1"}

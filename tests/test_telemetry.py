@@ -1,0 +1,609 @@
+"""Flight-recorder telemetry tests (singa_tpu/obs/ + tools/trace.py).
+
+The observability plane's claims, each pinned directly: events buffer
+with ZERO step-path I/O and zero device syncs (flush only at cadence
+boundaries), every resilience lifecycle event lands in the per-rank
+JSONL log, spans export to a valid Chrome trace, the profile@K trigger
+brackets exactly its steps, and the Timers/Performance accumulator
+edges the display line is built on behave at zero accumulation.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu.config import parse_model_config
+from singa_tpu.obs import FlightRecorder, config_hash, recorder_for_job
+from singa_tpu.resilience import FaultPlan, FaultPlanError, supervisor
+from singa_tpu.tools import trace as trace_tool
+from singa_tpu.utils import Performance, Timers
+
+from test_resilience import make_job
+
+
+# ---------------------------------------------------------------------------
+# recorder core: buffering, flushing, thread-safety of the contract
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_buffers_until_flush(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "events"), rank=3, run_id="abc123")
+    rec.event("run_start", step=0, attempt=1)
+    rec.step = 7
+    rec.event("fault", fault="crash@7")  # inherits the stamped step
+    # recording does NO I/O: not even the events dir exists yet
+    assert not os.path.exists(str(tmp_path / "events"))
+    assert rec.writes == 0
+    rec.flush()
+    assert rec.writes == 1
+    lines = open(rec.path).read().splitlines()
+    recs = [json.loads(l) for l in lines]
+    assert [r["kind"] for r in recs] == ["run_start", "fault"]
+    assert all(r["rank"] == 3 and r["run"] == "abc123" for r in recs)
+    assert recs[0]["step"] == 0 and recs[1]["step"] == 7
+    assert all("ts" in r and "mono" in r for r in recs)
+    # an empty flush appends nothing and opens nothing
+    rec.flush()
+    assert rec.writes == 1
+    # flushes append, never truncate
+    rec.event("run_stop", step=12, status="ok")
+    rec.flush()
+    assert len(open(rec.path).read().splitlines()) == 3
+
+
+def test_recorder_span_records_and_off_switch(tmp_path):
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    with rec.span("assemble", track="feeder"):
+        pass
+    rec.record_span("train", 123.0, 0.5, steps=4)
+    rec.flush()
+    recs = [json.loads(l) for l in open(rec.path)]
+    assert [r["name"] for r in recs] == ["assemble", "train"]
+    assert recs[0]["track"] == "feeder"
+    assert recs[1]["steps"] == 4 and recs[1]["dur"] == 0.5
+    # trace_spans off: span recording is a no-op, lifecycle events stay
+    off = FlightRecorder(str(tmp_path / "off"), rank=0, trace_spans=False)
+    with off.span("x"):
+        pass
+    off.record_span("y", 0.0, 1.0)
+    off.event("run_start")
+    assert off.recorded == 1
+
+
+def test_recorder_rejects_device_values_loudly(tmp_path):
+    """The no-device-sync guard: a jnp array smuggled into a payload is
+    DROPPED at flush (with a loud log), never silently serialized via a
+    device sync."""
+    logs = []
+    rec = FlightRecorder(str(tmp_path), rank=0, log=logs.append)
+    rec.event("bad", value=jnp.ones((2,)))
+    rec.event("good", value=1.5)
+    rec.flush()
+    recs = [json.loads(l) for l in open(rec.path)]
+    assert [r["kind"] for r in recs] == ["good"]
+    assert any("unserializable" in s for s in logs)
+    # ALL records dropped: nothing is written — not even a blank line
+    # that would break strict JSONL readers
+    allbad = FlightRecorder(str(tmp_path / "allbad"), rank=0,
+                            log=logs.append)
+    allbad.event("bad", value=jnp.ones((2,)))
+    allbad.flush()
+    assert allbad.writes == 0 and not os.path.exists(allbad.path)
+
+
+def test_config_hash_deterministic():
+    cfg = parse_model_config(
+        'name: "a"\ntrain_steps: 4\nupdater { base_learning_rate: 0.1 }'
+    )
+    cfg2 = parse_model_config(
+        'name: "a"\ntrain_steps: 4\nupdater { base_learning_rate: 0.1 }'
+    )
+    assert config_hash(cfg) == config_hash(cfg2)
+    cfg2.train_steps = 5
+    assert config_hash(cfg) != config_hash(cfg2)
+
+
+def test_recorder_for_job_gating(tmp_path):
+    """No workspace -> None; telemetry.enabled false -> None; otherwise
+    a recorder targeting <workspace>/events."""
+    from singa_tpu.config.schema import ClusterConfig
+
+    cfg = parse_model_config(
+        'name: "a"\ntrain_steps: 4\nupdater { base_learning_rate: 0.1 }'
+    )
+    assert recorder_for_job(cfg, None) is None
+    cluster = ClusterConfig()
+    cluster.workspace = str(tmp_path / "ws")
+    rec = recorder_for_job(cfg, cluster)
+    assert rec is not None
+    assert rec.path.endswith(os.path.join("events", "rank_0.jsonl"))
+    assert rec.run_id == config_hash(cfg)
+    off = parse_model_config(
+        'name: "a"\ntrain_steps: 4\ntelemetry { enabled: false }\n'
+        'updater { base_learning_rate: 0.1 }'
+    )
+    assert recorder_for_job(off, cluster) is None
+
+
+# ---------------------------------------------------------------------------
+# Timers / Performance accumulator edges (the display line's substrate)
+# ---------------------------------------------------------------------------
+
+
+def test_timers_zero_accumulation_edges():
+    t = Timers()
+    # nothing accumulated: means and shares are 0, never a ZeroDivision
+    assert t.mean_ms("train") == 0.0
+    assert t.share("data", "train") == 0.0
+    assert t.steps("train") == 0
+    assert t.to_string() == "no timing"
+    with t.phase("train", steps=4):
+        pass
+    # a zero-duration phase still counts its occurrence and steps
+    assert t.steps("train") == 4
+    assert t.share("train", "data") == pytest.approx(1.0)
+    assert t.share("data", "train") == 0.0
+    t.reset()
+    assert t.steps("train") == 0 and t.mean_ms("train") == 0.0
+
+
+def test_timers_span_sink_receives_every_occurrence():
+    got = []
+    t = Timers(span_sink=lambda name, t0, dur, steps: got.append(
+        (name, steps)
+    ))
+    with t.phase("train", steps=8):
+        pass
+    with t.phase("data"):
+        pass
+    assert got == [("train", 8), ("data", 1)]
+    t.reset()  # reset clears accumulators but keeps the sink attached
+    with t.phase("eval", steps=2):
+        pass
+    assert got[-1] == ("eval", 2)
+
+
+def test_performance_update_summed_count_accounting():
+    p = Performance()
+    p.update_summed({"loss": {"loss": jnp.float32(6.0)}}, nsteps=3)
+    assert p.count == 3
+    assert p.avg()["loss"]["loss"] == pytest.approx(2.0)
+    # the nsteps=0 degenerate: a zero-length window is a NO-OP — its
+    # sums must not skew the window's averages with count unchanged
+    p.update_summed({"loss": {"loss": jnp.float32(100.0)}}, nsteps=0)
+    assert p.count == 3
+    assert p.avg()["loss"]["loss"] == pytest.approx(2.0)
+    p.update_summed({"loss": {"loss": jnp.float32(4.0)}}, nsteps=1)
+    assert p.count == 4
+    assert p.avg()["loss"]["loss"] == pytest.approx(2.5)
+
+
+def test_performance_zero_state():
+    p = Performance()
+    assert p.count == 0
+    assert p.avg() == {}
+    assert p.to_string() == "no metrics"
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: events at cadence, zero step-path I/O
+# ---------------------------------------------------------------------------
+
+
+def test_step_path_never_writes_or_syncs(tmp_path):
+    """The overhead contract, structurally: with telemetry attached,
+    N train steps perform ZERO file writes (events buffer only) and
+    record spans without touching the device; the first write happens
+    at an explicit flush."""
+    from singa_tpu.trainer import Trainer
+
+    cfg, cluster, _ = make_job(tmp_path, train_steps=50,
+                               checkpoint_frequency=0)
+    trainer = Trainer(cfg, cluster, seed=0, log=lambda s: None,
+                      prefetch=False, device_cache=True)
+    rec = FlightRecorder(
+        os.path.join(cluster.workspace, "events"), rank=0
+    )
+    trainer.attach_telemetry(rec)
+    for step in range(6):
+        trainer.train_one_batch(step)
+    assert rec.writes == 0
+    assert not os.path.exists(rec.path)
+    # spans were recorded for every data/train phase occurrence
+    assert rec.recorded >= 12
+    rec.flush()
+    assert rec.writes == 1 and os.path.exists(rec.path)
+    recs = [json.loads(l) for l in open(rec.path)]
+    assert all(r["kind"] == "span" for r in recs)
+    assert {r["name"] for r in recs} == {"data", "train"}
+
+
+def test_supervised_run_event_log(tmp_path):
+    """A supervised run's whole story lands in the event log: run_start,
+    display-cadence step records (metrics + phase means + steps/s),
+    checkpoint write + LATEST promotion, fault firing, crash, restart,
+    run_stop — and flushes happen only at cadence/lifecycle edges."""
+    cfg, cluster, _ = make_job(tmp_path, train_steps=12,
+                               checkpoint_frequency=5)
+    cfg.display_frequency = 4
+    rc = supervisor.run(cfg, cluster, seed=0, faults="crash@7",
+                        log=lambda s: None)
+    assert rc == 0
+    ev = os.path.join(cluster.workspace, "events", "rank_0.jsonl")
+    recs = [json.loads(l) for l in open(ev)]
+    kinds = [r["kind"] for r in recs if r["kind"] != "span"]
+    assert kinds.count("run_start") == 2  # attempt 1 + auto-resume
+    assert "fault" in kinds and "crash" in kinds and "restart" in kinds
+    assert "ckpt_save" in kinds and "ckpt_written" in kinds
+    assert "ckpt_latest" in kinds
+    assert kinds[-1] == "run_stop"
+    stop = [r for r in recs if r["kind"] == "run_stop"][-1]
+    assert stop["data"]["status"] == "ok" and stop["step"] == 12
+    # the restart event carries cause + backoff
+    restart = next(r for r in recs if r["kind"] == "restart")
+    assert "InjectedCrash" in restart["data"]["cause"]
+    assert "backoff_s" in restart["data"]
+    # step records: metrics, per-phase means, steps/s — all host floats
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert steps, "no display-cadence step records"
+    for s in steps:
+        d = s["data"]
+        assert "train" in d["phase_ms"]
+        assert isinstance(d["steps_per_s"], float)
+        assert d["metrics"]  # loss layer averages
+    # run identity: every record carries the config-hash run id
+    assert all(r["run"] == config_hash(cfg) for r in recs)
+
+
+def test_display_line_has_steps_per_s(tmp_path):
+    logs = []
+    cfg, cluster, _ = make_job(tmp_path, train_steps=8,
+                               checkpoint_frequency=0)
+    cfg.display_frequency = 4
+    rc = supervisor.run(cfg, cluster, seed=0, log=logs.append)
+    assert rc == 0
+    display = [s for s in logs if "samples/s" in s]
+    assert display and all("steps/s" in s for s in display)
+    # non-LM config: no tok/s readout
+    assert all("tok/s" not in s for s in display)
+
+
+def test_tokens_per_step_and_tok_s_display(tmp_path):
+    """LM configs (kSequenceData) derive tok/s from the existing
+    accumulators: tokens/step = batch x seq_len."""
+    from singa_tpu.data.loader import synthetic_token_arrays, write_records
+    from singa_tpu.trainer import Trainer
+
+    shard = str(tmp_path / "tokens")
+    write_records(shard, *synthetic_token_arrays(64, seq_len=16, vocab=32))
+    cfg = parse_model_config(f"""
+name: "lm-tok"
+train_steps: 4
+display_frequency: 2
+updater {{ type: "kSGD" base_learning_rate: 0.1 param_type: "Param" }}
+neuralnet {{
+  layer {{ name: "data" type: "kSequenceData"
+          data_param {{ path: "{shard}" batchsize: 8 }} }}
+  layer {{ name: "embed" type: "kEmbedding" srclayers: "data"
+          embedding_param {{ vocab_size: 32 embedding_dim: 16 }}
+          param {{ name: "tok" init_method: "kGaussain" std: 0.02 }}
+          param {{ name: "pos" init_method: "kGaussain" std: 0.02 }} }}
+  layer {{ name: "head" type: "kDense" srclayers: "embed"
+          dense_param {{ num_output: 32 bias_term: false }}
+          param {{ name: "weight" init_method: "kGaussain" std: 0.05 }} }}
+  layer {{ name: "loss" type: "kLMLoss" srclayers: "head" srclayers: "data" }}
+}}
+""")
+    logs = []
+    trainer = Trainer(cfg, None, seed=0, log=logs.append,
+                      prefetch=False, device_cache=True)
+    assert trainer._tokens_per_step == 8 * 16
+    # drive the display branch without training: seed the accumulators
+    # the line is derived from
+    trainer.perf.update({"loss": {"loss": 2.0}})
+    with trainer.timers.phase("train"):
+        pass
+    trainer._post_events(0)
+    display = [s for s in logs if "samples/s" in s]
+    assert display and "tok/s" in display[0] and "steps/s" in display[0]
+
+
+# ---------------------------------------------------------------------------
+# profiler trigger
+# ---------------------------------------------------------------------------
+
+
+def test_profile_trigger_brackets_steps(tmp_path):
+    """profile@3:steps=2 produces a non-empty jax.profiler trace dir and
+    the telemetry events pin the bracket to exactly steps [3, 5)."""
+    cfg, cluster, _ = make_job(tmp_path, train_steps=8,
+                               checkpoint_frequency=0)
+    rc = supervisor.run(cfg, cluster, seed=0, faults="profile@3:steps=2",
+                        log=lambda s: None)
+    assert rc == 0
+    xprof = os.path.join(cluster.workspace, "xprof")
+    assert os.path.isdir(xprof) and os.listdir(xprof)
+    ev = os.path.join(cluster.workspace, "events", "rank_0.jsonl")
+    recs = [json.loads(l) for l in open(ev)]
+    start = next(r for r in recs if r["kind"] == "profile_start")
+    stop = next(r for r in recs if r["kind"] == "profile_stop")
+    assert start["step"] == 3 and start["data"]["stop_at"] == 5
+    assert stop["step"] == 5
+
+
+def test_profile_trigger_absent_is_noop(tmp_path):
+    cfg, cluster, _ = make_job(tmp_path, train_steps=6,
+                               checkpoint_frequency=0)
+    rc = supervisor.run(cfg, cluster, seed=0, log=lambda s: None)
+    assert rc == 0
+    assert not os.path.isdir(os.path.join(cluster.workspace, "xprof"))
+
+
+def test_profile_trigger_closes_at_run_end(tmp_path):
+    """A bracket the run ends inside still stops (and writes) the trace
+    instead of leaking an open profiler session."""
+    cfg, cluster, _ = make_job(tmp_path, train_steps=6,
+                               checkpoint_frequency=0)
+    rc = supervisor.run(cfg, cluster, seed=0,
+                        faults="profile@5:steps=50", log=lambda s: None)
+    assert rc == 0
+    ev = os.path.join(cluster.workspace, "events", "rank_0.jsonl")
+    recs = [json.loads(l) for l in open(ev)]
+    assert any(r["kind"] == "profile_stop" for r in recs)
+    assert os.listdir(os.path.join(cluster.workspace, "xprof"))
+
+
+def test_fault_grammar_profile_and_steps_qualifier():
+    plan = FaultPlan.parse("profile@20:steps=5:rank=1")
+    (spec,) = plan.specs
+    assert (spec.kind, spec.at, spec.steps, spec.rank) == (
+        "profile", 20, 5, 1
+    )
+    assert str(spec) == "profile@20:steps=5:rank=1"
+    # steps defaults to None (trigger treats it as 1)
+    assert FaultPlan.parse("profile@4").specs[0].steps is None
+    for bad in (
+        "crash@7:steps=2",  # steps is profile-only
+        "profile@3:steps=0",  # bracket must cover >= 1 step
+        "profile@3:steps=x",
+        "profile@3:bogus=1",
+    ):
+        with pytest.raises(FaultPlanError):
+            FaultPlan.parse(bad)
+
+
+def test_fault_firings_are_recorded(tmp_path):
+    plan = FaultPlan.parse("crash@7,corrupt_ckpt@2")
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    plan.recorder = rec
+    rec.step = 33
+    assert plan.fire("corrupt_ckpt", 2) is not None
+    assert plan.fire("crash", 7) is not None
+    assert plan.fire("crash", 7) is None  # once-only: no second event
+    rec.flush()
+    recs = [json.loads(l) for l in open(rec.path)]
+    assert [r["data"]["fault"] for r in recs] == ["corrupt_ckpt@2", "crash@7"]
+    # ordinal-keyed kinds inherit the stamped step; step-keyed use at
+    assert recs[0]["step"] == 33 and recs[1]["step"] == 7
+
+
+# ---------------------------------------------------------------------------
+# tools/trace.py: merge + summarize
+# ---------------------------------------------------------------------------
+
+
+def _write_rank_log(events_dir, rank, records, torn_tail=False):
+    os.makedirs(events_dir, exist_ok=True)
+    with open(os.path.join(events_dir, f"rank_{rank}.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        if torn_tail:
+            f.write('{"ts": 1.0, "kind": "trunc')  # no newline, torn
+
+
+def test_trace_merge_two_ranks(tmp_path):
+    ev = str(tmp_path / "events")
+    base = 1000.0
+    for rank in (0, 1):
+        _write_rank_log(ev, rank, [
+            {"ts": base + rank * 0.25, "mono": 1.0, "rank": rank,
+             "run": "r", "step": 0, "kind": "run_start",
+             "data": {"attempt": 1}},
+            {"ts": base + 1.0, "mono": 2.0, "rank": rank, "run": "r",
+             "step": 4, "kind": "span", "name": "train",
+             "track": "phases", "dur": 0.5, "steps": 4},
+            {"ts": base + 2.0 + rank * 0.5, "mono": 3.0, "rank": rank,
+             "run": "r", "step": 4, "kind": "step",
+             "data": {"steps_per_s": 8.0}},
+        ], torn_tail=(rank == 1))
+    rc = trace_tool.main([str(tmp_path), "-o", str(tmp_path / "t.json")])
+    assert rc == 0
+    trace = json.load(open(tmp_path / "t.json"))
+    evs = trace["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert len(spans) == 2 and spans[0]["dur"] == pytest.approx(5e5)
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert {e["name"] for e in instants} == {"run_start", "step"}
+    # timestamps are relative to the earliest record, microseconds
+    assert min(e["ts"] for e in evs if e["ph"] != "M") == 0.0
+    # metadata names both rank processes
+    meta = [e for e in evs if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {m["args"]["name"] for m in meta} == {"rank 0", "rank 1"}
+
+    summary = trace_tool.summarize(trace_tool.load_events(str(tmp_path))[0])
+    # per-step p50 from the 4-step span: 500ms/4
+    assert summary["step_time_ms"]["p50"] == pytest.approx(125.0)
+    # rank skew: the same display step landed 0.5s apart
+    assert summary["max_rank_skew_s"] == pytest.approx(0.5)
+    assert summary["ranks"] == {"0": 3, "1": 3}
+
+
+def test_trace_tolerates_torn_tail(tmp_path):
+    ev = str(tmp_path / "events")
+    _write_rank_log(ev, 0, [
+        {"ts": 1.0, "mono": 1.0, "rank": 0, "run": "r", "step": 0,
+         "kind": "run_start"},
+    ], torn_tail=True)
+    records, skipped = trace_tool.load_events(str(tmp_path))
+    assert len(records) == 1 and skipped == 1
+
+
+def test_trace_missing_dir_errors(tmp_path):
+    assert trace_tool.main([str(tmp_path / "nope")]) == 2
+
+
+def test_trace_on_real_run_is_valid_chrome_trace(tmp_path):
+    """End to end: a supervised run's events merge into a parseable
+    Chrome trace whose spans and lifecycle markers cover the run."""
+    cfg, cluster, _ = make_job(tmp_path, train_steps=8,
+                               checkpoint_frequency=5)
+    cfg.display_frequency = 4
+    assert supervisor.run(cfg, cluster, seed=0, log=lambda s: None) == 0
+    assert trace_tool.main([cluster.workspace]) == 0
+    trace = json.load(open(os.path.join(cluster.workspace, "trace.json")))
+    evs = trace["traceEvents"]
+    assert evs
+    names = {e["name"] for e in evs if e["ph"] == "i"}
+    assert {"run_start", "step", "ckpt_written", "run_stop"} <= names
+    assert any(
+        e["ph"] == "X" and e["name"] == "train" for e in evs
+    )
+    summary = trace_tool.summarize(
+        trace_tool.load_events(cluster.workspace)[0]
+    )
+    assert summary["counts"]["checkpoints_written"] >= 1
+    assert summary["counts"]["latest_promotions"] >= 1
+    assert summary["step_time_ms"]["n"] > 0
+
+
+# ---------------------------------------------------------------------------
+# lifecycle events from the resilience seams
+# ---------------------------------------------------------------------------
+
+
+def test_drain_and_watchdog_events(tmp_path):
+    """A sigterm drill's drain is in the log (reason + checkpoint) and
+    the run_stop carries the resumable exit code."""
+    cfg, cluster, _ = make_job(tmp_path, train_steps=20,
+                               checkpoint_frequency=5)
+    rc = supervisor.run(cfg, cluster, seed=0, faults="sigterm@6",
+                        log=lambda s: None)
+    assert rc == 75
+    ev = os.path.join(cluster.workspace, "events", "rank_0.jsonl")
+    recs = [json.loads(l) for l in open(ev)]
+    drain = next(r for r in recs if r["kind"] == "drain")
+    assert drain["step"] == 6
+    assert "sigterm" in drain["data"]["reason"]
+    assert drain["data"]["checkpoint"].endswith("step_6.npz")
+    stop = [r for r in recs if r["kind"] == "run_stop"][-1]
+    assert stop["data"]["exit_code"] == 75
+    assert stop["data"]["status"] == "preempted"
+    # in order: the drain precedes the exit record
+    kinds = [r["kind"] for r in recs]
+    assert kinds.index("drain") < kinds.index("run_stop")
+
+
+def test_watchdog_stall_event(tmp_path):
+    """Stall dumps reach the event log, not just stderr."""
+    from singa_tpu.resilience.watchdog import Watchdog
+
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    dog = Watchdog(timeout=0.05, log=lambda s: None)
+    dog.recorder = rec
+    dog.beat(3)
+    dog.start()
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while dog.stalls == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    dog.stop()
+    assert dog.stalls >= 1
+    recs = [json.loads(l) for l in open(rec.path)]
+    stall = next(r for r in recs if r["kind"] == "watchdog_stall")
+    assert stall["step"] == 3
+    assert "thread" in stall["data"]["stacks"]
+    # the stall flushed immediately (a hung run may never flush again)
+    assert rec.writes >= 1
+
+
+def test_guard_rollback_event(tmp_path):
+    cfg, cluster, _ = make_job(
+        tmp_path, train_steps=12, checkpoint_frequency=2,
+        resilience="guard_policy: kRollback guard_rollback_after: 1",
+    )
+    rc = supervisor.run(cfg, cluster, seed=0, faults="nanloss@5",
+                        log=lambda s: None)
+    assert rc == 0
+    ev = os.path.join(cluster.workspace, "events", "rank_0.jsonl")
+    recs = [json.loads(l) for l in open(ev)]
+    rb = next(r for r in recs if r["kind"] == "guard_rollback")
+    assert rb["data"]["consecutive_bad"] >= 1
+    assert rb["data"]["checkpoint"]
+    assert rb["data"]["lr_scale"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# config schema + lint coverage
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_block_parses_with_defaults():
+    cfg = parse_model_config(
+        'name: "t"\ntrain_steps: 1\ntelemetry { }\n'
+        'updater { base_learning_rate: 0.1 }'
+    )
+    assert cfg.telemetry.enabled is True
+    assert cfg.telemetry.trace_spans is True
+    assert cfg.telemetry.events_subfolder == "events"
+    assert cfg.telemetry.profile_subfolder == "xprof"
+
+
+def test_telemetry_block_lint_coverage():
+    """netlint's raw-config walk covers the telemetry block: typo'd
+    knobs get CFG001 with did-you-mean."""
+    from singa_tpu.lint import Collector, lint_model_text
+
+    base = (
+        'name: "t"\ntrain_steps: 1\n{tel}\n'
+        'updater {{ base_learning_rate: 0.1 }}\n'
+        "neuralnet {{\n"
+        '  layer {{ name: "data" type: "kShardData"\n'
+        '    data_param {{ path: "x" batchsize: 4 }} }}\n'
+        "}}\n"
+    )
+    for typo, want in (
+        ("telemetry { trace_span: true }", "trace_spans"),
+        ("telemetry { enable: true }", "enabled"),
+        ("telemetry { profile_subdir: \"p\" }", "profile_subfolder"),
+    ):
+        col = Collector()
+        lint_model_text(base.format(tel=typo), "job.conf", col)
+        assert any(
+            d.code == "CFG001" and want in (d.fix_hint or "")
+            for d in col.sorted()
+        ), (typo, [str(d) for d in col.sorted()])
+
+
+def test_async_writer_spans(tmp_path):
+    """Async checkpoint writes appear as ckpt_writer-track spans — the
+    merged trace shows the write pipeline overlapping the step stream."""
+    cfg, cluster, _ = make_job(
+        tmp_path, train_steps=12, checkpoint_frequency=5,
+        resilience="async_checkpoint: true",
+    )
+    rc = supervisor.run(cfg, cluster, seed=0, log=lambda s: None)
+    assert rc == 0
+    ev = os.path.join(cluster.workspace, "events", "rank_0.jsonl")
+    recs = [json.loads(l) for l in open(ev)]
+    writer_spans = [
+        r for r in recs
+        if r["kind"] == "span" and r.get("track") == "ckpt_writer"
+    ]
+    assert writer_spans, "no ckpt_writer spans recorded"
+    saves = [r for r in recs if r["kind"] == "ckpt_save"]
+    assert saves and all(s["data"]["mode"] == "async" for s in saves)
