@@ -152,6 +152,17 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
         ph: round(t.total(ph) / timed_steps * 1e3, 4) for ph in t.phases()
     }
     phase_ms.setdefault("data", 0.0)
+    # update-phase ms measured in isolation (tools/update_stall.py's
+    # slope fit over chained updater applications): the number the
+    # zero_update sharding is allowed to move, reported per row so a
+    # regression stays attributable. Never sinks the row.
+    try:
+        from singa_tpu.tools.update_stall import measure_update_ms
+
+        update_ms = round(measure_update_ms(trainer), 4)
+    except Exception:
+        traceback.print_exc()
+        update_ms = None
     return {
         "name": name,
         "value": round(value, 1),
@@ -166,6 +177,12 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
         # which input path fed the row (cached / stream / prefetch /
         # sync) — regressions stay attributable to the feeder mode
         "feeder": trainer.feeder_mode,
+        # how the weight update is laid out (replicated / zero) plus
+        # the bytes the zero mode exists to shrink and the phase it is
+        # allowed to move — the ZeRO win, measured per row
+        "update_mode": trainer.update_mode,
+        "opt_state_bytes_per_device": trainer.opt_state_bytes_per_device(),
+        "update_ms": update_ms,
         "method": "two-window slope fit (marginal per-step cost)",
     }
 
@@ -230,7 +247,7 @@ def bench_cifar_alexnet(n1=256, n2=1280, batch=256):
 
 
 def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
-                 name="tinylm", conf="tinylm.conf"):
+                 name="tinylm", conf="tinylm.conf", zero=False):
     from singa_tpu.config import load_model_config
     from singa_tpu.data.loader import synthetic_token_arrays, write_records
 
@@ -245,6 +262,7 @@ def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
             layer.data_param.path = shard
             if batch:
                 layer.data_param.batchsize = batch
+    cfg.zero_update = zero
     _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
     return _run_workload(
         name, cfg, n1, n2, unit="tokens/sec", tokens_per_sample=seq_len
@@ -328,6 +346,19 @@ def bench_lm_32k_d128(n1=16, n2=48):
     )
 
 
+def bench_lm_d128_zero(n1=256, n2=1280):
+    """tinylm_d128 under the ZeRO update sharding (zero_update: true) —
+    the standing regression row for the sharded update path. On the
+    bench chip's data axis the row must hold the tinylm_d128 number
+    (the update is the same elementwise math; only its layout changes)
+    while `opt_state_bytes_per_device` shrinks by the data width —
+    both visible in the row, so a zero regression is attributable to
+    either throughput or footprint, never silent."""
+    return bench_tinylm(
+        n1, n2, name="lm_d128_zero", conf="tinylm_d128.conf", zero=True
+    )
+
+
 def bench_rbm(n1=128, n2=640, batch=100):
     """The CD engine (BASELINE config 4) on examples/mnist/rbm.conf:
     greedy layerwise CD-1 over the 784-1000-500-250-30 stack, one jitted
@@ -392,6 +423,7 @@ BENCHES = (
     ("lm_32k", bench_lm_32k),
     ("lm_longctx_d128", bench_lm_longctx_d128),
     ("lm_32k_d128", bench_lm_32k_d128),
+    ("lm_d128_zero", bench_lm_d128_zero),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),

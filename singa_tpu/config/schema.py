@@ -716,6 +716,16 @@ class ModelConfig(Message):
         # shard files, arrays stay device-sharded end to end (pods) —
         # restore auto-detects the format from the path
         "checkpoint_format": Field("enum", "npz", enum=("npz", "sharded")),
+        # --- singa-tpu extension: ZeRO-style cross-replica update
+        # sharding (arxiv 2004.13336; parallel/shardings.py
+        # zero_update_shardings). true = reduce-scatter grads to
+        # per-rank shards over the data axis, run the optimizer on each
+        # rank's shard only (updater slots live sharded, shrinking
+        # per-device opt-state bytes by the data-parallel degree), and
+        # allgather fresh params for the next forward. Loss-identical
+        # to the replicated update (the math between the collectives is
+        # elementwise); false = the reference's replicated update. ---
+        "zero_update": Field("bool", False),
         # --- singa-tpu extension: mixed-precision compute. Params stay
         # fp32 (master copies, updater math in fp32); forward/backward
         # matmuls run in this dtype so the MXU sees bf16. "" = fp32. ---

@@ -202,7 +202,11 @@ class ChunkStager(_Prefetcher):
         """``sources``: {layer: (images, labels, batchsize)} host arrays;
         ``schedule(step) -> nsteps`` (0 ends the stream);
         ``cursors() -> {layer: record position}`` read at start;
-        ``put(np_array) -> device array`` commits a staged block."""
+        ``put(np_array, layer, kind) -> device array`` commits a staged
+        block — ``layer``/``kind`` ("image"/"label") let the trainer
+        stage each array to its data-axis batch sharding (each device
+        receives only its slice of the block) instead of a full-block
+        broadcast to every device."""
         super().__init__()
         self._sources = sources
         self._bps = batches_per_step
@@ -223,8 +227,8 @@ class ChunkStager(_Prefetcher):
             span = nsteps * self._bps * bs
             idx = (self._pos[name] + np.arange(span)) % n
             block[name] = {
-                "image": self._put(images[idx]),
-                "label": self._put(labels[idx]),
+                "image": self._put(images[idx], name, "image"),
+                "label": self._put(labels[idx], name, "label"),
             }
             self._pos[name] = int((self._pos[name] + span) % n)
             positions[name] = self._pos[name]

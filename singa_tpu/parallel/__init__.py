@@ -40,6 +40,7 @@ from .shardings import (
     param_shardings,
     replicated,
     state_shardings,
+    zero_update_shardings,
 )
 
 __all__ = [
@@ -65,6 +66,7 @@ __all__ = [
     "param_shardings",
     "replicated",
     "state_shardings",
+    "zero_update_shardings",
     "elastic_sync",
     "random_sync",
     "sample_sync_indices",

@@ -148,9 +148,71 @@ def param_paddings(mesh: Mesh, net: Net) -> dict[str, tuple]:
     return out
 
 
+def zero_update_shardings(
+    mesh: Mesh,
+    net: Net,
+    param_sh: dict[str, NamedSharding],
+    *,
+    warn: bool = False,
+) -> dict[str, NamedSharding]:
+    """ZeRO-style UPDATE layout (PAPERS.md arxiv 2004.13336): each
+    param's forward sharding plus the data axis on the first
+    still-replicated dim the data-parallel degree divides evenly.
+
+    Constraining grads to this layout makes GSPMD lower the data-axis
+    grad sync to a reduce-scatter (each rank receives only its shard's
+    sum); updater slots STORED in it shrink per-device by the data
+    width; constraining the fresh params back to their forward
+    shardings after the update is the allgather. This composes with
+    the existing fallbacks: dims padded for an indivisible model axis
+    use their STORED (padded) length, and a param with no evenly
+    divisible free dim keeps its forward sharding — its update stays
+    replicated, the same replicate fallback as indivisible expert
+    counts, announced via ``warnings.warn`` when ``warn``.
+    """
+    ndata = mesh.shape[DATA_AXIS]
+    out: dict[str, NamedSharding] = {}
+    for name, spec, sharded, pad in _param_layout(mesh, net):
+        shape = list(spec.shape)
+        if pad:
+            shape[sharded[0]] += pad
+        axes = list(tuple(param_sh[name].spec))
+        axes += [None] * (len(shape) - len(axes))
+        dim = None
+        if ndata > 1:
+            dim = next(
+                (
+                    d
+                    for d, size in enumerate(shape)
+                    if axes[d] is None and size and size % ndata == 0
+                ),
+                None,
+            )
+        if dim is None:
+            if ndata > 1 and warn:
+                warnings.warn(
+                    f"zero_update: no free dim of param {name!r} (stored "
+                    f"shape {tuple(shape)}) is divisible by the data axis "
+                    f"({ndata}); its update stays replicated",
+                    stacklevel=3,
+                )
+            out[name] = param_sh[name]
+        else:
+            axes[dim] = DATA_AXIS
+            out[name] = NamedSharding(mesh, P(*axes))
+    return out
+
+
 def state_shardings(
-    param_sh: dict[str, NamedSharding], slots: tuple[str, ...]
+    param_sh: dict[str, NamedSharding],
+    slots: tuple[str, ...],
+    update_sh: dict[str, NamedSharding] | None = None,
 ) -> dict[str, dict[str, NamedSharding]]:
     """Updater slots (history/update) mirror their param's sharding, like
-    the reference keeps history blobs beside data blobs (param.h:136)."""
-    return {name: {s: sh for s in slots} for name, sh in param_sh.items()}
+    the reference keeps history blobs beside data blobs (param.h:136).
+    Under ``zero_update`` the slots follow the UPDATE layout instead
+    (``update_sh`` from zero_update_shardings) — each rank holds only
+    its shard of the optimizer state, the per-device shrink that is the
+    point of ZeRO."""
+    src = update_sh if update_sh is not None else param_sh
+    return {name: {s: sh for s in slots} for name, sh in src.items()}

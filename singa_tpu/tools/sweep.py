@@ -26,7 +26,8 @@ import sys
 import time
 
 
-def _child(model_conf: str, nworkers: int, steps: int) -> None:
+def _child(model_conf: str, nworkers: int, steps: int,
+           zero_update: bool = False) -> None:
     """Run `steps` training steps on an nworkers-wide data mesh; print one
     JSON line. Runs inside the sweep's subprocess (env already set)."""
     import jax
@@ -46,6 +47,8 @@ def _child(model_conf: str, nworkers: int, steps: int) -> None:
     cfg.test_steps = cfg.validation_steps = 0
     cfg.display_frequency = 0
     cfg.checkpoint_frequency = 0
+    if zero_update:
+        cfg.zero_update = True
     mesh = build_mesh(nworkers, 1, jax.devices()[:nworkers])
     trainer = make_trainer(cfg, None, mesh=mesh, log=lambda s: None)
     warmup = min(3, steps - 1)
@@ -61,6 +64,12 @@ def _child(model_conf: str, nworkers: int, steps: int) -> None:
         "nworkers": nworkers,
         "batch": trainer.train_net.batchsize,
         "samples_per_sec": (steps - warmup) * trainer.train_net.batchsize / dt,
+        # which input path and update layout fed the point (bench.py's
+        # feeder/update_mode row fields) — a scaling knee stays
+        # attributable to the data path or the update sharding
+        "feeder": trainer.feeder_mode,
+        "update_mode": trainer.update_mode,
+        "opt_state_bytes_per_device": trainer.opt_state_bytes_per_device(),
     }))
 
 
@@ -69,6 +78,7 @@ def run_sweep(
     workers: list[int],
     steps: int,
     virtual: bool,
+    zero_update: bool = False,
 ) -> list[dict]:
     results = []
     for nw in workers:
@@ -82,7 +92,8 @@ def run_sweep(
         proc = subprocess.run(
             [sys.executable, "-m", "singa_tpu.tools.sweep", "--_child",
              "--model_conf", model_conf, "--nworkers", str(nw),
-             "--steps", str(steps)],
+             "--steps", str(steps)]
+            + (["--zero_update"] if zero_update else []),
             env=env, capture_output=True, text=True,
         )
         if proc.returncode != 0:
@@ -106,21 +117,31 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--virtual", action="store_true",
                     help="CPU-hosted virtual devices (set automatically "
                     "when the host has no accelerator plurality)")
+    ap.add_argument("--zero_update", action="store_true",
+                    help="sweep with the ZeRO update sharding "
+                    "(zero_update: true) — opt-state bytes per device "
+                    "should FALL as nworkers grows")
     ap.add_argument("--json", default=None, help="also write results here")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--nworkers", type=int, default=0, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args._child:
-        _child(args.model_conf, args.nworkers, args.steps)
+        _child(args.model_conf, args.nworkers, args.steps,
+               zero_update=args.zero_update)
         return 0
 
-    results = run_sweep(args.model_conf, args.workers, args.steps, args.virtual)
-    print(f"{'nworkers':>8} {'batch':>6} {'samples/s':>12} {'efficiency':>10}")
+    results = run_sweep(args.model_conf, args.workers, args.steps,
+                        args.virtual, zero_update=args.zero_update)
+    print(
+        f"{'nworkers':>8} {'batch':>6} {'samples/s':>12} {'efficiency':>10} "
+        f"{'update':>10} {'opt-B/dev':>10}"
+    )
     for r in results:
         print(
             f"{r['nworkers']:>8} {r['batch']:>6} "
-            f"{r['samples_per_sec']:>12.0f} {r['efficiency']:>10.2f}"
+            f"{r['samples_per_sec']:>12.0f} {r['efficiency']:>10.2f} "
+            f"{r['update_mode']:>10} {r['opt_state_bytes_per_device']:>10}"
         )
     if args.json:
         with open(args.json, "w") as f:

@@ -106,6 +106,9 @@ class CDTrainer(Trainer):
         self.train_net.forward(
             params, batch, training=True, rng=rng, layer_hook=hook
         )
+        # the zero_update seam is engine-independent: CD grads reduce-
+        # scatter and update shard-local exactly like backprop grads
+        grads = self._constrain_grads(grads)
         ok = None
         if lr_scale is not None:
             ok = jnp.isfinite(grad_norm_sq(grads))
@@ -116,9 +119,7 @@ class CDTrainer(Trainer):
             )
         rbm_params = {n: params[n] for n in grads}
         rbm_state = {n: state[n] for n in grads}
-        new_p, new_s = self.updater.apply(
-            step, rbm_params, grads, rbm_state, self.specs
-        )
+        new_p, new_s = self._apply_update(step, rbm_params, grads, rbm_state)
         params = {**params, **new_p}
         state = {**state, **new_s}
         return params, state, buffers, metrics, ok

@@ -65,6 +65,10 @@ class ReplicaTrainer(Trainer):
 
     _allow_device_cache = True
     _supports_buffers = True
+    #: the replica protocol stacks params/slots (R, ...) under its own
+    #: _rep_param_sh layout — zero_update's data-axis update sharding
+    #: would fight it, so the knob is rejected loudly
+    _supports_zero_update = False
 
     @property
     def _batches_per_step(self) -> int:  # one stream batch per replica

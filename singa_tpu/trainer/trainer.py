@@ -36,6 +36,7 @@ from ..parallel import (
     param_shardings,
     replicated,
     state_shardings,
+    zero_update_shardings,
 )
 from ..params import init_params
 from ..resilience.guard import (
@@ -72,6 +73,10 @@ class Trainer:
     #: stream batches consumed per train step (the replica trainer feeds
     #: one batch per replica)
     _batches_per_step = 1
+    #: engines whose update layout is their own (the replica protocol's
+    #: (R, ...)-stacked slots) reject zero_update instead of silently
+    #: running it replicated
+    _supports_zero_update = True
 
     def __init__(
         self,
@@ -141,7 +146,25 @@ class Trainer:
                 )
                 net.pipeline_mesh = self.mesh
         self.param_sh = param_shardings(self.mesh, self.train_net)
-        self.state_sh = state_shardings(self.param_sh, self.updater.SLOTS)
+        # --- ZeRO-style update sharding (zero_update: reduce-scatter
+        # grads, shard-local optimizer, allgather params — arxiv
+        # 2004.13336). The updater slots LIVE in the update layout, so
+        # per-device opt-state bytes shrink by the data-parallel degree;
+        # the step itself picks the layout up via _constrain_grads /
+        # _apply_update. ---
+        self._zero_sh = None
+        if model_cfg.zero_update:
+            if not self._supports_zero_update:
+                raise ConfigError(
+                    f"{type(self).__name__} does not support zero_update "
+                    "(the replica protocol owns its own update layout)"
+                )
+            self._zero_sh = zero_update_shardings(
+                self.mesh, self.train_net, self.param_sh, warn=True
+            )
+        self.state_sh = state_shardings(
+            self.param_sh, self.updater.SLOTS, update_sh=self._zero_sh
+        )
         #: pad-to-multiple storage for indivisible kLayerPartition dims
         #: (the reference's uneven-partition contract, neuralnet.cc:160-162
         #: — see parallel/shardings.py). Nets slice back to logical shapes
@@ -649,16 +672,82 @@ class Trainer:
         (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
+        # zero_update: pin the grads to the update layout FIRST, so the
+        # data-axis grad sync lowers to a reduce-scatter and everything
+        # downstream — the guard's norm, the updater math — runs on
+        # each rank's shard only
+        grads = self._constrain_grads(grads)
         ok = None
         if lr_scale is not None:
             ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm_sq(grads))
             grads = jax.tree.map(
                 lambda g: g * lr_scale.astype(g.dtype), grads
             )
-        params, state = self.updater.apply(
-            step, params, grads, state, self.specs
-        )
+        params, state = self._apply_update(step, params, grads, state)
         return params, state, new_buffers, metrics, ok
+
+    # ------------------------------------------------------------------
+    # update sharding (zero_update — parallel/shardings.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def update_mode(self) -> str:
+        """How the weight update is laid out across the data axis:
+        ``replicated`` (every rank applies the full update — the
+        reference's ParamSync semantics) or ``zero`` (reduce-scatter
+        grads, shard-local optimizer, allgather params)."""
+        return "zero" if self._zero_sh is not None else "replicated"
+
+    def opt_state_bytes_per_device(self) -> int:
+        """Bytes of updater state resident on EACH device — the
+        footprint zero_update shrinks by the data-parallel degree.
+        Computed from the shard shapes: no host transfer, no sync."""
+        total = 0
+        for slots in self.state.values():
+            for v in slots.values():
+                shape = v.sharding.shard_shape(v.shape)
+                total += int(np.prod(shape, dtype=np.int64)) * v.dtype.itemsize
+        return total
+
+    def _constrain_grads(self, grads: dict) -> dict:
+        """Zero mode: constrain each grad to its update sharding, so
+        GSPMD replaces the grad all-reduce with a reduce-scatter (each
+        rank receives only its shard's sum) and the guard's grad-norm
+        becomes shard-local partials psum'd to one scalar — no gather.
+        Identity when the update is replicated. ``grads`` may cover a
+        subset of params (the CD engine's greedy layerwise grads)."""
+        if self._zero_sh is None:
+            return grads
+        wsc = jax.lax.with_sharding_constraint
+        return {n: wsc(g, self._zero_sh[n]) for n, g in grads.items()}
+
+    def _apply_update(self, step, params: dict, grads: dict, state: dict):
+        """Updater.apply under the configured ``update_mode``.
+
+        ``replicated``: every rank runs the full elementwise update.
+        ``zero``: params are viewed through the update layout (a slice
+        of the replicated value — free), the updater math runs on each
+        rank's shard against the already-reduce-scattered grads and the
+        resident sharded slots, and the fresh params are constrained
+        back to their forward shardings, which GSPMD satisfies with one
+        allgather. Loss-identical to the replicated update: every op
+        between the constraints is elementwise, so shard boundaries
+        cannot change any value."""
+        if self._zero_sh is None:
+            return self.updater.apply(step, params, grads, state, self.specs)
+        wsc = jax.lax.with_sharding_constraint
+        shard_view = {
+            n: wsc(p, self._zero_sh[n]) for n, p in params.items()
+        }
+        new_p, new_s = self.updater.apply(
+            step, shard_view, grads, state, self.specs
+        )
+        new_p = {n: wsc(v, self.param_sh[n]) for n, v in new_p.items()}
+        new_s = {
+            n: {s: wsc(v, self._zero_sh[n]) for s, v in slots.items()}
+            for n, slots in new_s.items()
+        }
+        return new_p, new_s
 
     def _eval_batch_metrics(self, net: Net, params, buffers, batch) -> dict:
         """One eval batch -> {losslayer: metrics}. The single overridable
@@ -780,14 +869,23 @@ class Trainer:
                 if arr.dtype != orig:
                     self._cache_cast[(id(net), name)] = jnp.dtype(orig)
                 sources[name] = (arr, pipe.labels, pipe.batchsize)
-            def put(a):
+            def put(a, name, kind):
+                # staged blocks land DATA-SHARDED along the stacked
+                # batch dim (the same batch shardings the sync path
+                # uses): each device receives only its 1/ndata slice of
+                # the block instead of a full-block broadcast — on wide
+                # meshes the host->device traffic drops by the data
+                # width. The scan body's gather + batch constraint
+                # reassemble exactly the sync path's per-step batches.
+                sh = self.batch_sh.get(name)
+                sh = sh[kind] if sh is not None else self._repl
                 # stager-thread span (obs/): each staged block's
                 # host->device commit becomes its own trace track
                 rec = self.telemetry
                 if rec is None:
-                    return jax.device_put(jnp.asarray(a), self._repl)
+                    return jax.device_put(jnp.asarray(a), sh)
                 with rec.span("stage_block", track="stager"):
-                    return jax.device_put(jnp.asarray(a), self._repl)
+                    return jax.device_put(jnp.asarray(a), sh)
 
             self._stager = ChunkStager(
                 sources,

@@ -150,7 +150,7 @@ def test_chunk_stager_blocks_and_reset():
         batches_per_step=1,
         schedule=lambda step: {0: 2, 2: 3, 5: 2, 7: 3}.get(step, 1),
         cursors=lambda: {"d": 6},
-        put=lambda a: a,
+        put=lambda a, name, kind: a,
     )
     block, pos = stager.take(0, 2)
     # 2 steps x batch 4 from record 6, wrapping at 10

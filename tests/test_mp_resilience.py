@@ -40,12 +40,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _conf_text(shard: str, steps: int, heartbeat_s: float) -> str:
+def _conf_text(
+    shard: str, steps: int, heartbeat_s: float, zero: bool = False
+) -> str:
     return f"""
 name: "mp-resilience"
 train_steps: {steps}
 checkpoint_frequency: 5
 checkpoint_format: "sharded"
+zero_update: {"true" if zero else "false"}
 updater {{ base_learning_rate: 0.05 momentum: 0.9 param_type: "Param" }}
 neuralnet {{
   layer {{ name: "data" type: "kShardData"
@@ -74,14 +77,15 @@ resilience {{
 """
 
 
-def _write_job(tmp_path, tag: str, steps: int, heartbeat_s: float):
+def _write_job(tmp_path, tag: str, steps: int, heartbeat_s: float,
+               zero: bool = False):
     """-> (model_conf path, cluster_conf path, checkpoint dir)."""
     shard = str(tmp_path / "shard")
     if not os.path.isdir(shard):
         write_records(shard, *synthetic_arrays(128, seed=5))
     ws = str(tmp_path / f"ws_{tag}")
     model_conf = tmp_path / f"job_{tag}.conf"
-    model_conf.write_text(_conf_text(shard, steps, heartbeat_s))
+    model_conf.write_text(_conf_text(shard, steps, heartbeat_s, zero=zero))
     cluster_conf = tmp_path / f"cluster_{tag}.conf"
     cluster_conf.write_text(
         f'nworkers: 2\nnprocs_per_group: 1\nworkspace: "{ws}"\n'
@@ -165,6 +169,73 @@ def test_sigterm_on_one_rank_drains_both_at_same_step(tmp_path):
         assert os.path.exists(os.path.join(latest, f"proc_{k}.npz"))
         assert os.path.exists(os.path.join(latest, f"commit_{k}.json"))
     assert retention.validate_checkpoint(latest)
+
+
+@pytest.mark.slow
+def test_zero_update_drill_drains_and_resumes_bitwise(tmp_path):
+    """The zero_update drill (ISSUE 7 satellite): under the ZeRO update
+    sharding, ``sigterm@12:rank=0`` drains BOTH ranks at step 12; the
+    committed sharded save carries each rank's DISTINCT opt-state
+    shard (the slots live sharded across the two processes); and a
+    relaunch resumes to completion bitwise-identical to an
+    uninterrupted 2-rank zero run."""
+    # uninterrupted oracle, separate workspace
+    clean_model, clean_cluster, _ = _write_job(
+        tmp_path, "zclean", steps=20, heartbeat_s=30.0, zero=True
+    )
+    clean = _launch(tmp_path, "zclean", clean_model, clean_cluster)
+    for rank, (rc, log_text, _) in clean.items():
+        assert rc == 0, f"clean rank {rank} rc={rc}\nlog:\n{log_text}"
+
+    model_conf, cluster_conf, ck_dir = _write_job(
+        tmp_path, "zdrill", steps=20, heartbeat_s=30.0, zero=True
+    )
+    drilled = _launch(
+        tmp_path, "zdrill", model_conf, cluster_conf,
+        faults="sigterm@12:rank=0",
+    )
+    for rank, (rc, log_text, _) in drilled.items():
+        assert rc == EXIT_RESUMABLE, (
+            f"rank {rank} rc={rc}\nlog:\n{log_text}"
+        )
+        assert "drained at step 12" in log_text, f"rank {rank}:\n{log_text}"
+    latest = retention.resolve_latest(ck_dir)
+    assert latest is not None and latest.endswith("step_12.ckpt"), latest
+    assert retention.validate_checkpoint(latest)
+    # the committed save holds PER-RANK opt-state shards: both proc
+    # files carry slot entries, with different global-index boxes
+    boxes = {}
+    for k in range(2):
+        z = np.load(os.path.join(latest, f"proc_{k}.npz"))
+        slots = [
+            e for e in z.files
+            if e.startswith("s|") and not e.endswith("idx")
+        ]
+        assert slots, f"proc_{k}.npz carries no opt-state shard"
+        (entry,) = [e for e in slots if e.startswith("s|fc1/w|")]
+        boxes[k] = z[f"{entry}##idx"].tolist()
+    assert boxes[0] != boxes[1], (
+        f"both ranks wrote the SAME opt-state box {boxes[0]} — the "
+        "slots are not sharded across processes"
+    )
+
+    # relaunch BOTH ranks: resume from the drained step_12 save
+    resumed = _launch(tmp_path, "zresume", model_conf, cluster_conf)
+    dumps = []
+    for rank, (rc, log_text, params) in resumed.items():
+        assert rc == 0, f"resumed rank {rank} rc={rc}\nlog:\n{log_text}"
+        assert "resumed sharded from" in log_text and "step_12" in log_text
+        dumps.append(params)
+    oracle = clean[0][2]
+    assert set(dumps[0]) == set(oracle)
+    for name in dumps[0]:
+        np.testing.assert_array_equal(
+            dumps[0][name], dumps[1][name], err_msg=name
+        )
+        np.testing.assert_array_equal(
+            dumps[0][name], oracle[name],
+            err_msg=f"zero resume diverged from uninterrupted: {name}",
+        )
 
 
 @pytest.mark.slow
