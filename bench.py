@@ -163,6 +163,17 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
     except Exception:
         traceback.print_exc()
         update_ms = None
+    # gradient-collective machinery ms measured in isolation
+    # (tools/collective_stall.py's chained-reduce slope fit): the number
+    # the grad_comm quantize/overlap path is allowed to move, reported
+    # per row so a regression stays attributable. Never sinks the row.
+    try:
+        from singa_tpu.tools.collective_stall import measure_comm_ms
+
+        comm_ms = round(measure_comm_ms(trainer), 4)
+    except Exception:
+        traceback.print_exc()
+        comm_ms = None
     return {
         "name": name,
         "value": round(value, 1),
@@ -183,6 +194,12 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
         "update_mode": trainer.update_mode,
         "opt_state_bytes_per_device": trainer.opt_state_bytes_per_device(),
         "update_ms": update_ms,
+        # how gradients cross the data axis (exact / quantized + wire
+        # dtype) and the isolated cost of that machinery — the
+        # grad_comm analog of update_mode/update_ms
+        "comm_mode": trainer.comm_mode,
+        "comm_dtype": trainer.comm_dtype,
+        "comm_ms": comm_ms,
         "method": "two-window slope fit (marginal per-step cost)",
     }
 
@@ -247,9 +264,11 @@ def bench_cifar_alexnet(n1=256, n2=1280, batch=256):
 
 
 def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
-                 name="tinylm", conf="tinylm.conf", zero=False):
+                 name="tinylm", conf="tinylm.conf", zero=False,
+                 grad_comm="", comm_buckets=0):
     from singa_tpu.config import load_model_config
     from singa_tpu.data.loader import synthetic_token_arrays, write_records
+    from singa_tpu.parallel import apply_grad_comm_tag
 
     cfg = load_model_config(os.path.join(REPO, "examples", "lm", conf))
     tmp = _tmpdir()
@@ -263,6 +282,9 @@ def bench_tinylm(n1=256, n2=1280, seq_len=128, batch=0, n_samples=256,
             if batch:
                 layer.data_param.batchsize = batch
     cfg.zero_update = zero
+    apply_grad_comm_tag(cfg, grad_comm)
+    if comm_buckets and cfg.grad_comm is not None:
+        cfg.grad_comm.buckets = comm_buckets
     _prep_cfg(cfg, 4 * (n1 + n2))  # conf already sets bfloat16
     return _run_workload(
         name, cfg, n1, n2, unit="tokens/sec", tokens_per_sample=seq_len
@@ -359,6 +381,21 @@ def bench_lm_d128_zero(n1=256, n2=1280):
     )
 
 
+def bench_lm_d128_q8(n1=256, n2=1280):
+    """tinylm_d128 under the quantized + bucketized gradient collective
+    (grad_comm: quantized int8, error feedback, 4 reverse-topo buckets)
+    — the standing regression row for the grad_comm path. On the bench
+    chip the row must hold the tinylm_d128 number (the quantize math is
+    cheap elementwise work; the wire value the data-axis collective
+    moves is a quarter the bytes) while `comm_mode`/`comm_dtype`/
+    `comm_ms` make any regression attributable to the collective
+    machinery rather than the model."""
+    return bench_tinylm(
+        n1, n2, name="lm_d128_q8", conf="tinylm_d128.conf",
+        grad_comm="q8", comm_buckets=4,
+    )
+
+
 def bench_rbm(n1=128, n2=640, batch=100):
     """The CD engine (BASELINE config 4) on examples/mnist/rbm.conf:
     greedy layerwise CD-1 over the 784-1000-500-250-30 stack, one jitted
@@ -424,6 +461,7 @@ BENCHES = (
     ("lm_longctx_d128", bench_lm_longctx_d128),
     ("lm_32k_d128", bench_lm_32k_d128),
     ("lm_d128_zero", bench_lm_d128_zero),
+    ("lm_d128_q8", bench_lm_d128_q8),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),

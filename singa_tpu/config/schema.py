@@ -659,6 +659,37 @@ class ResilienceConfig(Message):
     }
 
 
+GRAD_COMM_MODES = ("exact", "quantized")
+GRAD_COMM_DTYPES = ("int8", "bf16")
+
+
+class GradCommConfig(Message):
+    """singa-tpu extension: quantized + overlapped gradient collectives
+    (parallel/collectives.py; PAPERS.md arxiv 2506.17615 EQuARX).
+
+    ``mode: quantized`` casts each bucket's gradients to a scaled
+    low-precision wire format (``dtype``) before the data-axis
+    reduction — composing with ``zero_update``'s reduce-scatter layout —
+    and dequantizes after; with ``error_feedback`` (default on) the
+    compression error persists as per-param residual buffers re-injected
+    next step, so convergence matches fp32 (validated end to end by
+    tools/convergence.py ``--grad_comm q8``). ``buckets: N`` partitions
+    the params into N reverse-topo groups whose reductions are chained
+    in gradient-readiness order, so bucket k's collective overlaps
+    bucket k+1's backward segment instead of one barrier at step end
+    (N also sets the quantization-scale granularity; 0 = per-param
+    scales, no ordering chain). ``mode: exact`` (default, = no block)
+    keeps today's bitwise-identical fp32 path. Rejected by the replica
+    engine, whose EASGD protocol owns its own sync math."""
+
+    FIELDS = {
+        "mode": Field("enum", "exact", enum=GRAD_COMM_MODES),
+        "dtype": Field("enum", "int8", enum=GRAD_COMM_DTYPES),
+        "error_feedback": Field("bool", True),
+        "buckets": Field("int", 0),
+    }
+
+
 class TelemetryConfig(Message):
     """singa-tpu extension: the flight-recorder telemetry plane
     (singa_tpu/obs/). Always-on by default — a job with a workspace
@@ -726,6 +757,10 @@ class ModelConfig(Message):
         # to the replicated update (the math between the collectives is
         # elementwise); false = the reference's replicated update. ---
         "zero_update": Field("bool", False),
+        # --- singa-tpu extension: quantized + overlapped gradient
+        # collectives (parallel/collectives.py; see GradCommConfig).
+        # Absent = the exact fp32 gradient collective. ---
+        "grad_comm": Field("message", message=GradCommConfig),
         # --- singa-tpu extension: mixed-precision compute. Params stay
         # fp32 (master copies, updater math in fp32); forward/backward
         # matmuls run in this dtype so the MXU sees bf16. "" = fp32. ---

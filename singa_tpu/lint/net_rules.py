@@ -61,6 +61,11 @@ SHD001 = rule(
 SHD003 = rule(
     "SHD003", WARNING, "batchsize not divisible by the data axis width"
 )
+CMM001 = rule(
+    "CMM001",
+    ERROR,
+    "active grad_comm block combined with the replica (async PS) engine",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -322,6 +327,45 @@ def cluster_rules(
             col.emit(CLU001, path, str(e))
         return None
     return None if ngroups_err else widths
+
+
+# ---------------------------------------------------------------------------
+# engine-compatibility rules (model conf x cluster conf)
+# ---------------------------------------------------------------------------
+
+
+def engine_rules(
+    model_cfg: ModelConfig, cluster_cfg: ClusterConfig | None, path: str,
+    col: Collector,
+) -> None:
+    """CMM001 — the static mirror of the trainer-constructor rejection
+    (trainer/replica.py ``_supports_grad_comm``): an asynchronous
+    cluster with ``nservers > 0`` routes a backprop job to the replica
+    engine, whose EASGD/RandomSync protocol owns its own gradient-sync
+    math — an active ``grad_comm`` block (quantized mode or bucketized
+    overlap) would be rejected at engine construction, so lint says it
+    before any pod time is burned. Mirrors the ``zero_update``
+    rejection; the CD engine rides the shared seam and is fine."""
+    gc = getattr(model_cfg, "grad_comm", None)
+    if gc is None or (gc.mode == "exact" and gc.buckets <= 1):
+        return
+    if (
+        cluster_cfg is not None
+        and cluster_cfg.nservers > 0
+        and not cluster_cfg.synchronous
+        and model_cfg.alg != "kContrastiveDivergence"
+        and model_cfg.updater is not None
+    ):
+        col.emit(
+            CMM001,
+            path,
+            f"grad_comm (mode {gc.mode!r}, buckets {gc.buckets}) with an "
+            "asynchronous nservers>0 cluster: the replica engine's "
+            "EASGD protocol owns its own gradient sync and rejects the "
+            "quantize/overlap machinery",
+            fix_hint="drop the grad_comm block, or run the synchronous "
+            "engine (synchronous: true / nservers: 0)",
+        )
 
 
 # ---------------------------------------------------------------------------

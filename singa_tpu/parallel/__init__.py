@@ -10,6 +10,15 @@ grad sync over ICI, all-gather/reduce-scatter for layer partitions) that the
 reference implemented by hand over TCP.
 """
 
+from .collectives import (
+    GradCommSpec,
+    apply_grad_comm_tag,
+    init_residuals,
+    is_residual_key,
+    reduce_gradients,
+    residual_key,
+    reverse_topo_buckets,
+)
 from .consistency import (
     elastic_sync,
     random_sync,
@@ -46,6 +55,13 @@ from .shardings import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "GradCommSpec",
+    "apply_grad_comm_tag",
+    "init_residuals",
+    "is_residual_key",
+    "reduce_gradients",
+    "residual_key",
+    "reverse_topo_buckets",
     "build_full_mesh",
     "build_mesh",
     "mesh_from_cluster",

@@ -12,14 +12,30 @@ reference length and width:
               5000 train / 1000 test with disjoint noise)
 
 Usage:  python -m singa_tpu.tools.convergence [mlp mlp_elastic conv alexnet]
+            [--grad_comm exact|q8|bf16] [--steps N] [--hidden_scale R]
+            [--batch N]
 
 Prints one JSON line per workload: {name, steps, wall_sec,
 steps_per_sec, final_test_accuracy, final_test_loss} — the convergence
 table in BASELINE.md records these.
+
+``--grad_comm`` runs the workload under a gradient-collective mode
+(parallel/collectives.py): ``q8`` = quantized int8 with error feedback,
+``bf16`` = quantized bf16, ``exact`` = an explicit exact block (must be
+bitwise-identical to no flag at all). This is the END-TO-END numerics
+validation for the quantized collective — CI's grad-comm parity gate
+runs the mlp workload with and without ``--grad_comm q8`` and asserts
+the final test loss/accuracy agree within tolerance, proving the error
+feedback preserves convergence over a whole run, not just one step.
+``--steps`` / ``--hidden_scale`` / ``--batch`` shrink the run for
+CPU-hosted CI (hidden_scale scales kInnerProduct widths, keeping the
+10-class head, like __graft_entry__._flagship_cfg); full-length parity
+numbers belong to accelerator runs.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -76,8 +92,25 @@ def _patch_paths(cfg, train: str, test: str, mean: str | None = None):
             p.meanfile = mean
 
 
-def run_workload(name: str, log=print) -> dict:
+def _shrink_cfg(cfg, steps: int, hidden_scale: float, batch: int):
+    """CPU-CI-sized cut of a full-length workload: fewer steps, scaled
+    kInnerProduct widths (the 10-class head kept), smaller batch."""
+    if steps:
+        cfg.train_steps = steps
+    for layer in cfg.neuralnet.layer:
+        p = getattr(layer, "inner_product_param", None)
+        if hidden_scale != 1.0 and p is not None and p.num_output > 10:
+            p.num_output = max(8, int(p.num_output * hidden_scale))
+        if batch and layer.data_param is not None and layer.data_param.path:
+            layer.data_param.batchsize = batch
+    return cfg
+
+
+def run_workload(name: str, log=print, *, grad_comm: str = "",
+                 steps: int = 0, hidden_scale: float = 1.0,
+                 batch: int = 0) -> dict:
     from ..config import load_cluster_config, load_model_config
+    from ..parallel import apply_grad_comm_tag
     from ..trainer import Trainer, make_trainer
 
     tmp = tempfile.mkdtemp(prefix=f"singa_tpu_conv_{name}_")
@@ -113,6 +146,8 @@ def run_workload(name: str, log=print) -> dict:
     else:
         raise ValueError(f"unknown workload {name!r}")
     cfg.checkpoint_frequency = 0  # no workspace configured for these runs
+    _shrink_cfg(cfg, steps, hidden_scale, batch)
+    apply_grad_comm_tag(cfg, grad_comm)
     if name in ("conv", "alexnet") and not cfg.compute_dtype:
         # fp32 convs lower with Precision.HIGHEST (multi-pass bf16
         # emulation, matching the reference's fp32 cblas accumulate);
@@ -144,16 +179,33 @@ def run_workload(name: str, log=print) -> dict:
         "wall_sec": round(wall, 1),
         "steps_per_sec": round(cfg.train_steps / wall, 1),
         "engine": type(trainer).__name__,
-        "final_test_accuracy": round(float(m["precision"]), 4),
-        "final_test_loss": round(float(m["loss"]), 4),
+        "grad_comm": grad_comm or "off",
+        "final_test_accuracy": round(float(m["precision"]), 6),
+        "final_test_loss": round(float(m["loss"]), 6),
     }
 
 
 def main(argv: list[str]) -> int:
-    names = argv or ["mlp", "mlp_elastic", "conv", "alexnet"]
+    ap = argparse.ArgumentParser(prog="convergence", description=__doc__)
+    ap.add_argument("workloads", nargs="*",
+                    default=["mlp", "mlp_elastic", "conv", "alexnet"])
+    ap.add_argument("--grad_comm", default="",
+                    choices=("", "exact", "q8", "bf16"),
+                    help="gradient-collective mode (q8 = quantized int8 "
+                    "with error feedback)")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="override train_steps (CI-sized runs)")
+    ap.add_argument("--hidden_scale", type=float, default=1.0,
+                    help="scale kInnerProduct widths (10-class head kept)")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="override data-layer batch size")
+    args = ap.parse_args(argv)
     quiet = lambda s: None  # noqa: E731
-    for name in names:
-        result = run_workload(name, log=quiet)
+    for name in args.workloads:
+        result = run_workload(
+            name, log=quiet, grad_comm=args.grad_comm, steps=args.steps,
+            hidden_scale=args.hidden_scale, batch=args.batch,
+        )
         print(json.dumps(result))
     return 0
 

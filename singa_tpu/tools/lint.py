@@ -28,6 +28,7 @@ import sys
 from ..config import textproto
 from ..lint import (
     Collector,
+    engine_rules,
     lint_cluster_text,
     lint_model_text,
     lint_python_file,
@@ -46,7 +47,8 @@ def _is_cluster_raw(raw: dict) -> bool:
 
 
 def _lint_conf(
-    path: str, col: Collector, widths: dict[str, int] | None
+    path: str, col: Collector, widths: dict[str, int] | None,
+    cluster_cfg=None,
 ) -> None:
     try:
         with open(path, "r", encoding="utf-8") as f:
@@ -66,6 +68,9 @@ def _lint_conf(
     model_cfg = lint_model_text(text, path, col, raw=raw)
     if model_cfg is None:
         return
+    # engine-compatibility checks need the cluster conf itself (engine
+    # selection reads nservers/synchronous, not the axis widths)
+    engine_rules(model_cfg, cluster_cfg, path, col)
     if col.count("ERROR") > errors_before:
         # the graph is already known-broken; building it would only
         # re-report the same breakage through SHP001. The config-level
@@ -150,6 +155,7 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     widths = None
+    cluster_cfg = None
     if args.cluster:
         try:
             with open(args.cluster, "r", encoding="utf-8") as f:
@@ -157,7 +163,7 @@ def main(argv: list[str] | None = None) -> int:
         except OSError as e:
             print(f"error: --cluster {args.cluster}: {e}", file=sys.stderr)
             return 2
-        _, widths = lint_cluster_text(ctext, args.cluster, col)
+        cluster_cfg, widths = lint_cluster_text(ctext, args.cluster, col)
 
     confs, pys, bad = _collect(args.paths)
     if bad:
@@ -172,7 +178,7 @@ def main(argv: list[str] | None = None) -> int:
     for path in confs:
         if cluster_real and os.path.realpath(path) == cluster_real:
             continue
-        _lint_conf(path, col, widths)
+        _lint_conf(path, col, widths, cluster_cfg=cluster_cfg)
     if args.self_lint:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         pys.extend(walk_source_files(pkg_root, (".py",)))

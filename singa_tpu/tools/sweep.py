@@ -27,7 +27,7 @@ import time
 
 
 def _child(model_conf: str, nworkers: int, steps: int,
-           zero_update: bool = False) -> None:
+           zero_update: bool = False, grad_comm: str = "") -> None:
     """Run `steps` training steps on an nworkers-wide data mesh; print one
     JSON line. Runs inside the sweep's subprocess (env already set)."""
     import jax
@@ -49,6 +49,10 @@ def _child(model_conf: str, nworkers: int, steps: int,
     cfg.checkpoint_frequency = 0
     if zero_update:
         cfg.zero_update = True
+    if grad_comm:
+        from ..parallel import apply_grad_comm_tag
+
+        apply_grad_comm_tag(cfg, grad_comm)
     mesh = build_mesh(nworkers, 1, jax.devices()[:nworkers])
     trainer = make_trainer(cfg, None, mesh=mesh, log=lambda s: None)
     warmup = min(3, steps - 1)
@@ -70,7 +74,19 @@ def _child(model_conf: str, nworkers: int, steps: int,
         "feeder": trainer.feeder_mode,
         "update_mode": trainer.update_mode,
         "opt_state_bytes_per_device": trainer.opt_state_bytes_per_device(),
+        # how gradients crossed the data axis at this point (exact /
+        # quantized + wire dtype) and the machinery's isolated marginal
+        # ms — a scaling knee stays attributable to the collective
+        "comm_mode": trainer.comm_mode,
+        "comm_dtype": trainer.comm_dtype,
+        "comm_ms": round(_comm_ms(trainer), 3),
     }))
+
+
+def _comm_ms(trainer) -> float:
+    from .collective_stall import measure_comm_ms
+
+    return measure_comm_ms(trainer, i1=2, i2=6, trials=1)
 
 
 def run_sweep(
@@ -79,6 +95,7 @@ def run_sweep(
     steps: int,
     virtual: bool,
     zero_update: bool = False,
+    grad_comm: str = "",
 ) -> list[dict]:
     results = []
     for nw in workers:
@@ -93,7 +110,8 @@ def run_sweep(
             [sys.executable, "-m", "singa_tpu.tools.sweep", "--_child",
              "--model_conf", model_conf, "--nworkers", str(nw),
              "--steps", str(steps)]
-            + (["--zero_update"] if zero_update else []),
+            + (["--zero_update"] if zero_update else [])
+            + (["--grad_comm", grad_comm] if grad_comm else []),
             env=env, capture_output=True, text=True,
         )
         if proc.returncode != 0:
@@ -121,6 +139,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="sweep with the ZeRO update sharding "
                     "(zero_update: true) — opt-state bytes per device "
                     "should FALL as nworkers grows")
+    ap.add_argument("--grad_comm", default="",
+                    choices=("", "exact", "q8", "bf16"),
+                    help="sweep with a grad_comm block (q8 = quantized "
+                    "int8 + error feedback; bf16 = quantized bf16) — "
+                    "the quantized wire format should HOLD efficiency "
+                    "as the data axis widens")
     ap.add_argument("--json", default=None, help="also write results here")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--nworkers", type=int, default=0, help=argparse.SUPPRESS)
@@ -128,20 +152,24 @@ def main(argv: list[str] | None = None) -> int:
 
     if args._child:
         _child(args.model_conf, args.nworkers, args.steps,
-               zero_update=args.zero_update)
+               zero_update=args.zero_update, grad_comm=args.grad_comm)
         return 0
 
     results = run_sweep(args.model_conf, args.workers, args.steps,
-                        args.virtual, zero_update=args.zero_update)
+                        args.virtual, zero_update=args.zero_update,
+                        grad_comm=args.grad_comm)
     print(
         f"{'nworkers':>8} {'batch':>6} {'samples/s':>12} {'efficiency':>10} "
-        f"{'update':>10} {'opt-B/dev':>10}"
+        f"{'update':>10} {'opt-B/dev':>10} {'comm':>14} {'comm-ms':>8}"
     )
     for r in results:
+        comm = r["comm_mode"] + (f":{r['comm_dtype']}" if r["comm_dtype"]
+                                 else "")
         print(
             f"{r['nworkers']:>8} {r['batch']:>6} "
             f"{r['samples_per_sec']:>12.0f} {r['efficiency']:>10.2f} "
-            f"{r['update_mode']:>10} {r['opt_state_bytes_per_device']:>10}"
+            f"{r['update_mode']:>10} {r['opt_state_bytes_per_device']:>10} "
+            f"{comm:>14} {r['comm_ms']:>8.3f}"
         )
     if args.json:
         with open(args.json, "w") as f:

@@ -12,10 +12,16 @@ multi-host incident:
       wall clock (each record carries both).
 
   --summarize       one JSON report instead: step-time p50/p99 (from
-      train spans, normalized per step), input/ckpt stall shares,
+      train spans, normalized per step), input/ckpt/comm stall shares,
       guard/fault/restart counts, checkpoint commit outcomes, and
       per-rank skew (max wall-clock spread of the same display step /
-      drain barrier across ranks).
+      drain barrier across ranks). The ``comm`` share comes from the
+      grad_comm calibration probe (a one-shot chained-reduce span the
+      trainer records at run start when quantized/overlapped gradient
+      collectives are active): per-reduction ms over the train span's
+      per-step p50 — the modeled fraction of the step the gradient-
+      collective machinery accounts for, not an on-step-path
+      measurement (the collective runs inside the jitted step).
 
 Usage::
 
@@ -171,6 +177,7 @@ def summarize(records: list[dict]) -> dict:
     # per-step time repeats with that weight so chunked windows don't
     # undercount relative to per-step dispatch
     per_step_ms: list[float] = []
+    comm_ms: list[float] = []
     phase_totals: dict[str, float] = {}
     for s in spans:
         if s.get("track") != "phases":
@@ -184,7 +191,12 @@ def summarize(records: list[dict]) -> dict:
         if name == "train":
             n = max(1, int(s.get("steps", 1)))
             per_step_ms.extend([dur / n * 1e3] * min(n, 4096))
+        elif name == "comm":
+            # calibration probe: dur covers `steps` chained reductions
+            n = max(1, int(s.get("steps", 1)))
+            comm_ms.append(dur / n * 1e3)
     per_step_ms.sort()
+    comm_ms.sort()
 
     train_t = phase_totals.get("train", 0.0)
     data_t = phase_totals.get("data", 0.0)
@@ -251,7 +263,19 @@ def summarize(records: list[dict]) -> dict:
         "stall_shares": {
             "input": round(data_t / step_path, 4) if step_path > 0 else 0.0,
             "ckpt": round(ckpt_t / step_path, 4) if step_path > 0 else 0.0,
+            # the gradient-collective machinery's modeled share of the
+            # step (probe p50 / train per-step p50; see docstring)
+            "comm": round(
+                _percentile(comm_ms, 0.50)
+                / _percentile(per_step_ms, 0.50),
+                4,
+            )
+            if comm_ms and per_step_ms and _percentile(per_step_ms, 0.50)
+            else 0.0,
         },
+        "comm_ms_per_step": round(_percentile(comm_ms, 0.50), 4)
+        if comm_ms
+        else None,
         "counts": {
             "faults": len(faults),
             "guard_rollbacks": guard_rollbacks,

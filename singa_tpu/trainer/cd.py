@@ -106,9 +106,12 @@ class CDTrainer(Trainer):
         self.train_net.forward(
             params, batch, training=True, rng=rng, layer_hook=hook
         )
-        # the zero_update seam is engine-independent: CD grads reduce-
-        # scatter and update shard-local exactly like backprop grads
-        grads = self._constrain_grads(grads)
+        # the zero_update/grad_comm seams are engine-independent: CD
+        # grads reduce-scatter, quantize, and update shard-local exactly
+        # like backprop grads (their error-feedback residuals ride the
+        # same buffer pytree)
+        grads, comm_bufs = self._reduce_grads(grads, buffers)
+        buffers = {**buffers, **comm_bufs}
         ok = None
         if lr_scale is not None:
             ok = jnp.isfinite(grad_norm_sq(grads))

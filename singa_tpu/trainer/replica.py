@@ -69,6 +69,10 @@ class ReplicaTrainer(Trainer):
     #: _rep_param_sh layout — zero_update's data-axis update sharding
     #: would fight it, so the knob is rejected loudly
     _supports_zero_update = False
+    #: the EASGD/RandomSync protocol owns its own gradient-sync math
+    #: (per-replica local steps + center pulls) — quantized/overlapped
+    #: gradient collectives are rejected loudly, like zero_update
+    _supports_grad_comm = False
 
     @property
     def _batches_per_step(self) -> int:  # one stream batch per replica

@@ -77,6 +77,10 @@ class Trainer:
     #: (R, ...)-stacked slots) reject zero_update instead of silently
     #: running it replicated
     _supports_zero_update = True
+    #: engines whose gradient sync is their own protocol (the replica
+    #: engine's EASGD rounds) reject an active grad_comm block instead
+    #: of silently skipping the quantize/overlap machinery
+    _supports_grad_comm = True
 
     def __init__(
         self,
@@ -162,6 +166,26 @@ class Trainer:
             self._zero_sh = zero_update_shardings(
                 self.mesh, self.train_net, self.param_sh, warn=True
             )
+        # --- quantized + overlapped gradient collectives (grad_comm:
+        # parallel/collectives.py — EQuARX-style scaled int8/bf16 wire
+        # cast with error-feedback residuals in the buffer pytree, and
+        # reverse-topo bucket chaining so bucket k's reduction overlaps
+        # bucket k+1's backward segment). None = today's exact fp32
+        # collective, traced bitwise-identically. ---
+        from ..parallel.collectives import GradCommSpec
+
+        self._comm = GradCommSpec.from_config(model_cfg.grad_comm)
+        if self._comm is not None and not self._supports_grad_comm:
+            raise ConfigError(
+                f"{type(self).__name__} does not support grad_comm mode "
+                f"{self._comm.mode!r} (the replica protocol owns its own "
+                "gradient sync math)"
+            )
+        #: grads-keyset -> reverse-topo bucket partition (cached: the CD
+        #: engine's greedy layerwise grads cover a param subset)
+        self._comm_bucket_cache: dict[frozenset, tuple] = {}
+        #: one-shot comm-cost calibration flag (run() probes once)
+        self._comm_probe_done = False
         self.state_sh = state_shardings(
             self.param_sh, self.updater.SLOTS, update_sh=self._zero_sh
         )
@@ -329,6 +353,17 @@ class Trainer:
             # keys) so they thread the jitted step and checkpoint with
             # the rest of training state for free
             buffers.update(init_guard_buffers())
+        if self._comm is not None and self._comm.wants_residuals:
+            # error-feedback residuals ride the buffer pytree the same
+            # way (STORED shapes — grads of padded params are padded):
+            # they checkpoint, restore, and roll back with training
+            # state, so compression error is never silently dropped
+            # across a resume
+            from ..parallel.collectives import init_residuals
+
+            buffers.update(
+                init_residuals(self._pad_stored(params), self._comm)
+            )
         #: stream positions waiting to be applied once pipelines exist
         self._resume_streams: dict[str, int] = {}
         if self.cfg.checkpoint and is_sharded_checkpoint(self.cfg.checkpoint):
@@ -672,11 +707,16 @@ class Trainer:
         (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
-        # zero_update: pin the grads to the update layout FIRST, so the
-        # data-axis grad sync lowers to a reduce-scatter and everything
-        # downstream — the guard's norm, the updater math — runs on
-        # each rank's shard only
-        grads = self._constrain_grads(grads)
+        # grad_comm seam: zero_update pins the grads to the update
+        # layout FIRST, so the data-axis grad sync lowers to a
+        # reduce-scatter and everything downstream — the guard's norm,
+        # the updater math — runs on each rank's shard only; quantized
+        # mode additionally casts each bucket to the low-precision wire
+        # format around that constraint, banking the compression error
+        # in the residual buffers (the guard and the update consume the
+        # DEQUANTIZED grads unchanged)
+        grads, comm_bufs = self._reduce_grads(grads, buffers)
+        new_buffers = {**new_buffers, **comm_bufs}
         ok = None
         if lr_scale is not None:
             ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm_sq(grads))
@@ -718,8 +758,91 @@ class Trainer:
         subset of params (the CD engine's greedy layerwise grads)."""
         if self._zero_sh is None:
             return grads
-        wsc = jax.lax.with_sharding_constraint
-        return {n: wsc(g, self._zero_sh[n]) for n, g in grads.items()}
+        return {n: self._constrain_one(n, g) for n, g in grads.items()}
+
+    def _constrain_one(self, name: str, arr):
+        """Per-tensor half of _constrain_grads — the ``constrain``
+        callback the grad_comm reduction applies to each QUANTIZED wire
+        tensor, so the data-axis reduce-scatter's operand is the
+        low-precision value, not the fp32 gradient."""
+        if self._zero_sh is None:
+            return arr
+        return jax.lax.with_sharding_constraint(arr, self._zero_sh[name])
+
+    # ------------------------------------------------------------------
+    # gradient collectives (grad_comm — parallel/collectives.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def comm_mode(self) -> str:
+        """How gradients cross the data axis: ``exact`` (today's fp32
+        collective) or ``quantized`` (scaled int8/bf16 wire cast with
+        error feedback)."""
+        return (
+            "quantized"
+            if self._comm is not None and self._comm.quantized
+            else "exact"
+        )
+
+    @property
+    def comm_dtype(self) -> str:
+        """Wire dtype of the quantized gradient collective ("" when the
+        collective is exact fp32)."""
+        if self._comm is not None and self._comm.quantized:
+            return self._comm.dtype
+        return ""
+
+    def _comm_buckets(self, names: frozenset) -> tuple:
+        """Reverse-topo bucket partition for this grads keyset, cached
+        (the CD engine's layerwise grads cover a param subset)."""
+        if names not in self._comm_bucket_cache:
+            from ..parallel.collectives import reverse_topo_buckets
+
+            self._comm_bucket_cache[names] = reverse_topo_buckets(
+                self.train_net, names, self._comm.buckets, self.specs
+            )
+        return self._comm_bucket_cache[names]
+
+    def _reduce_grads(self, grads: dict, buffers: dict):
+        """The grad_comm seam around _constrain_grads: -> (update-ready
+        grads, residual-buffer updates). With no active ``grad_comm``
+        block this IS _constrain_grads — the exact path traces
+        bitwise-identically to pre-grad_comm main."""
+        if self._comm is None:
+            return self._constrain_grads(grads), {}
+        from ..parallel.collectives import reduce_gradients
+
+        return reduce_gradients(
+            grads,
+            buffers,
+            self._comm,
+            self._comm_buckets(frozenset(grads)),
+            self._constrain_one,
+        )
+
+    def _maybe_record_comm_probe(self) -> None:
+        """One-shot comm-cost calibration (the flight recorder's ``comm``
+        span track): when the grad_comm machinery is active and
+        telemetry is attached, time a short isolated chained-reduce
+        program under the ``comm`` phase — the span's duration over its
+        round count is the per-step cost of the gradient-collective
+        machinery, which tools/trace.py --summarize reports next to the
+        train/data stall shares. Runs ONCE, before the cadence loop —
+        never on the step path — and a probe failure is logged and
+        dropped (calibration must not sink training)."""
+        if (
+            self.telemetry is None
+            or self._comm is None
+            or self._comm_probe_done
+        ):
+            return
+        self._comm_probe_done = True
+        try:
+            from ..tools.collective_stall import record_comm_probe
+
+            record_comm_probe(self)
+        except Exception as e:  # pragma: no cover - defensive
+            self.log(f"TELEMETRY: comm probe failed: {e}")
 
     def _apply_update(self, step, params: dict, grads: dict, state: dict):
         """Updater.apply under the configured ``update_mode``.
@@ -1370,6 +1493,9 @@ class Trainer:
             for net in (self.train_net, self.test_net, self.val_net):
                 if net is not None:
                     dump_net_json(net, vis)
+        # comm-cost calibration span (grad_comm + telemetry only; a
+        # one-shot probe off the step path)
+        self._maybe_record_comm_probe()
         # streaming scan chunks: a non-cached dataset no longer falls
         # back to one dispatch per step — the stager feeds the same
         # _run_chunk scan path from double-buffered staged blocks

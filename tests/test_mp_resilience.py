@@ -41,14 +41,21 @@ def _free_port() -> int:
 
 
 def _conf_text(
-    shard: str, steps: int, heartbeat_s: float, zero: bool = False
+    shard: str, steps: int, heartbeat_s: float, zero: bool = False,
+    grad_comm: bool = False,
 ) -> str:
+    gc = (
+        "grad_comm { mode: quantized dtype: int8 buckets: 2 }"
+        if grad_comm
+        else ""
+    )
     return f"""
 name: "mp-resilience"
 train_steps: {steps}
 checkpoint_frequency: 5
 checkpoint_format: "sharded"
 zero_update: {"true" if zero else "false"}
+{gc}
 updater {{ base_learning_rate: 0.05 momentum: 0.9 param_type: "Param" }}
 neuralnet {{
   layer {{ name: "data" type: "kShardData"
@@ -78,14 +85,16 @@ resilience {{
 
 
 def _write_job(tmp_path, tag: str, steps: int, heartbeat_s: float,
-               zero: bool = False):
+               zero: bool = False, grad_comm: bool = False):
     """-> (model_conf path, cluster_conf path, checkpoint dir)."""
     shard = str(tmp_path / "shard")
     if not os.path.isdir(shard):
         write_records(shard, *synthetic_arrays(128, seed=5))
     ws = str(tmp_path / f"ws_{tag}")
     model_conf = tmp_path / f"job_{tag}.conf"
-    model_conf.write_text(_conf_text(shard, steps, heartbeat_s, zero=zero))
+    model_conf.write_text(
+        _conf_text(shard, steps, heartbeat_s, zero=zero, grad_comm=grad_comm)
+    )
     cluster_conf = tmp_path / f"cluster_{tag}.conf"
     cluster_conf.write_text(
         f'nworkers: 2\nnprocs_per_group: 1\nworkspace: "{ws}"\n'
@@ -235,6 +244,67 @@ def test_zero_update_drill_drains_and_resumes_bitwise(tmp_path):
         np.testing.assert_array_equal(
             dumps[0][name], oracle[name],
             err_msg=f"zero resume diverged from uninterrupted: {name}",
+        )
+
+
+@pytest.mark.slow
+def test_quantized_zero_drill_drains_and_resumes_bitwise(tmp_path):
+    """The grad_comm drill (ISSUE 8 acceptance): quantized int8
+    gradient collectives COMPOSED with the ZeRO update sharding across
+    two real processes — the reduce-scatter constraint pins the
+    quantized wire tensor. ``sigterm@12:rank=0`` drains BOTH ranks at
+    step 12; the committed sharded save carries the error-feedback
+    residual buffers (compression error survives the preemption); and a
+    relaunch resumes to completion bitwise-identical to an
+    uninterrupted 2-rank quantized-zero run."""
+    clean_model, clean_cluster, _ = _write_job(
+        tmp_path, "qclean", steps=20, heartbeat_s=30.0, zero=True,
+        grad_comm=True,
+    )
+    clean = _launch(tmp_path, "qclean", clean_model, clean_cluster)
+    for rank, (rc, log_text, _) in clean.items():
+        assert rc == 0, f"clean rank {rank} rc={rc}\nlog:\n{log_text}"
+
+    model_conf, cluster_conf, ck_dir = _write_job(
+        tmp_path, "qdrill", steps=20, heartbeat_s=30.0, zero=True,
+        grad_comm=True,
+    )
+    drilled = _launch(
+        tmp_path, "qdrill", model_conf, cluster_conf,
+        faults="sigterm@12:rank=0",
+    )
+    for rank, (rc, log_text, _) in drilled.items():
+        assert rc == EXIT_RESUMABLE, (
+            f"rank {rank} rc={rc}\nlog:\n{log_text}"
+        )
+        assert "drained at step 12" in log_text, f"rank {rank}:\n{log_text}"
+    latest = retention.resolve_latest(ck_dir)
+    assert latest is not None and latest.endswith("step_12.ckpt"), latest
+    assert retention.validate_checkpoint(latest)
+    # the committed save carries the error-feedback residuals as
+    # buffer entries (they restore with training state on resume)
+    z = np.load(os.path.join(latest, "proc_0.npz"))
+    res_entries = [e for e in z.files if "__gradres__/" in e]
+    assert res_entries, (
+        f"no error-feedback residuals in the drained save: {z.files}"
+    )
+
+    # relaunch BOTH ranks: resume from the drained step_12 save
+    resumed = _launch(tmp_path, "qresume", model_conf, cluster_conf)
+    dumps = []
+    for rank, (rc, log_text, params) in resumed.items():
+        assert rc == 0, f"resumed rank {rank} rc={rc}\nlog:\n{log_text}"
+        assert "resumed sharded from" in log_text and "step_12" in log_text
+        dumps.append(params)
+    oracle = clean[0][2]
+    assert set(dumps[0]) == set(oracle)
+    for name in dumps[0]:
+        np.testing.assert_array_equal(
+            dumps[0][name], dumps[1][name], err_msg=name
+        )
+        np.testing.assert_array_equal(
+            dumps[0][name], oracle[name],
+            err_msg=f"quantized-zero resume diverged: {name}",
         )
 
 
