@@ -452,6 +452,47 @@ def bench_mnist_mlp_replica(n1=256, n2=1280):
     return _workload_result("mnist_mlp_replica", trainer, slope, ovh, ts)
 
 
+def bench_lm_d128_serve():
+    """The serving tier (singa_tpu/serve/) on the d_head=128 LM shape:
+    continuous batching at concurrency 8 with the paged KV cache vs the
+    same engine one stream at a time. The standing regression row for
+    the serving path — `tokens_per_s` is the row value, `p50_ms` /
+    `p99_ms` are request latency percentiles, `kv_blocks_used` the pool
+    high-water mark, `speedup` the continuous/sequential ratio the CI
+    serve-smoke job gates at >= 2x. Unlike the training rows this is a
+    request-level wall-clock measurement (tools/serve_bench.py), not a
+    two-window slope — serving latency IS the metric, there is no
+    fixed-overhead term to subtract."""
+    import io
+    from contextlib import redirect_stdout
+
+    from singa_tpu.tools import serve_bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        serve_bench.main([
+            "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+            "--requests", "12", "--max_new", "32", "--no_gate",
+        ])
+    r = json.loads(buf.getvalue().strip().splitlines()[-1])
+    return {
+        "name": "lm_d128_serve",
+        "value": r["tokens_per_s"],
+        "unit": "tokens/sec",
+        "tokens_per_s": r["tokens_per_s"],
+        "p50_ms": r["p50_ms"],
+        "p99_ms": r["p99_ms"],
+        "kv_blocks_used": r["kv_blocks_peak"],
+        "slot_occupancy": r["slot_occupancy"],
+        "speedup": r.get("speedup"),
+        "steady_speedup": r.get("steady_speedup"),
+        "seq_tokens_per_s": r.get("seq_tokens_per_s"),
+        "concurrency": r["concurrency"],
+        "token_mismatches": r.get("token_mismatches"),
+        "method": "serve_bench open-loop workload (request wall clock)",
+    }
+
+
 BENCHES = (
     ("mnist_mlp", bench_mnist_mlp),
     ("cifar_alexnet", bench_cifar_alexnet),
@@ -462,6 +503,7 @@ BENCHES = (
     ("lm_32k_d128", bench_lm_32k_d128),
     ("lm_d128_zero", bench_lm_d128_zero),
     ("lm_d128_q8", bench_lm_d128_q8),
+    ("lm_d128_serve", bench_lm_d128_serve),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),

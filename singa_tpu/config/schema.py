@@ -690,6 +690,33 @@ class GradCommConfig(Message):
     }
 
 
+class ServingConfig(Message):
+    """singa-tpu extension: the serving tier (singa_tpu/serve/) — the
+    capability analog of the reference's Server tier (one process
+    answering every worker's kGet/kPut, src/server/server.cc), here one
+    engine answering every client's generation request. ``slots`` is
+    the decode batch width (one donated fixed-shape step advances every
+    live slot per tick; admit/retire never recompiles); the KV cache is
+    paged — ``kv_blocks`` fixed-size blocks of ``kv_block_len``
+    positions each, allocated per request at admission and freed at
+    retirement, so concurrent streams share device memory instead of
+    each reserving max_len (admission backpressure when the pool is
+    exhausted). ``max_prefill_chunk`` bounds how much prompt one tick
+    prefills, so long prompts never stall live decode."""
+
+    FIELDS = {
+        # concurrent decode lanes in the single compiled step
+        "slots": Field("int", 8),
+        # positions per KV block; must divide the model's max_len
+        "kv_block_len": Field("int", 16),
+        # total pool blocks (incl. the reserved trash block);
+        # 0 = dense-equivalent sizing (every slot can hold max_len)
+        "kv_blocks": Field("int", 0),
+        # max prompt tokens prefilled per request per tick
+        "max_prefill_chunk": Field("int", 64),
+    }
+
+
 class TelemetryConfig(Message):
     """singa-tpu extension: the flight-recorder telemetry plane
     (singa_tpu/obs/). Always-on by default — a job with a workspace
@@ -776,6 +803,10 @@ class ModelConfig(Message):
         # --- singa-tpu extension: flight-recorder telemetry plane
         # (singa_tpu/obs/). Absent = enabled with defaults ---
         "telemetry": Field("message", message=TelemetryConfig),
+        # --- singa-tpu extension: serving tier (singa_tpu/serve/) —
+        # continuous-batching inference with a paged KV cache. Absent =
+        # serving defaults (tools/serve_bench.py, tools/generate.py) ---
+        "serving": Field("message", message=ServingConfig),
     }
 
 

@@ -182,6 +182,37 @@ class DeviceFeeder(_Prefetcher):
         self.consumed_positions = {}
 
 
+class BurstFeeder(_Prefetcher):
+    """A device feeder bounded to EXACTLY ``n`` batches — the serving
+    tier's request-batching discipline applied to a bounded burst (an
+    eval/validation cadence): batch k+1 assembles + transfers on the
+    worker thread while step k computes, and production STOPS after the
+    n-th item, so the thread never consumes records past the burst —
+    stream positions land exactly where the synchronous path leaves
+    them (checkpoint/resume parity needs that, not just value parity).
+    """
+
+    _SLOTS = 1
+
+    def __init__(self, assemble, n: int):
+        super().__init__()
+        self._assemble = assemble
+        self._left = int(n)
+
+    def _produce(self):
+        if self._left <= 0:
+            return None
+        self._left -= 1
+        # 1-tuple wrapper: the end-of-stream marker is None, a batch
+        # must never be mistaken for it
+        return (self._assemble(),)
+
+    def next(self):
+        if self._thread is None:
+            self._start()
+        return self._get()[0]
+
+
 class ChunkStager(_Prefetcher):
     """Chunk-granularity double buffering for streaming scan windows.
 

@@ -135,6 +135,28 @@ class Net:
 
     # ---------------- trace ----------------
 
+    def resolve_params(self, params: dict) -> dict:
+        """Param view every graph walk shares (forward AND the serving
+        tier's incremental decode, serve/conf_decode.py): shared params
+        resolve through their owner's array (ParamSpec.owner), and
+        pad-to-multiple storage (uneven kLayerPartition dims) slices
+        back to the logical shape. Ellipsis keeps any leading replica
+        axis (ReplicaTrainer stacks params as (R, ...)). The slice of
+        the zero tail has zero cotangent, so gradients/updater slots on
+        the tail stay exactly zero."""
+        resolved = dict(params)
+        for layer in self.layers:
+            for name, spec in layer.param_specs().items():
+                if spec.owner is not None:
+                    resolved[name] = params[spec.owner]
+        for name, logical in self.param_logical.items():
+            v = resolved.get(name)
+            if v is not None and v.shape[-len(logical):] != tuple(logical):
+                resolved[name] = v[
+                    (Ellipsis, *(slice(0, s) for s in logical))
+                ]
+        return resolved
+
     def forward(
         self,
         params: dict[str, jnp.ndarray],
@@ -167,22 +189,7 @@ class Net:
         if buffers is None:
             buffers = self.init_buffers()
         new_buffers = dict(buffers)
-        resolved = dict(params)
-        for layer in self.layers:
-            for name, spec in layer.param_specs().items():
-                if spec.owner is not None:
-                    resolved[name] = params[spec.owner]
-        # pad-to-multiple storage (uneven kLayerPartition dims): slice
-        # back to the logical shape. Ellipsis keeps any leading replica
-        # axis (ReplicaTrainer stacks params as (R, ...)). The slice of
-        # the zero tail has zero cotangent, so gradients/updater slots
-        # on the tail stay exactly zero.
-        for name, logical in self.param_logical.items():
-            v = resolved.get(name)
-            if v is not None and v.shape[-len(logical):] != tuple(logical):
-                resolved[name] = v[
-                    (Ellipsis, *(slice(0, s) for s in logical))
-                ]
+        resolved = self.resolve_params(params)
 
         acts: dict[str, Any] = {}
         slice_cursor: dict[str, int] = {}

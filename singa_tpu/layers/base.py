@@ -53,6 +53,13 @@ class Layer:
     #: layer's apply returns (out, aux_loss); Net.forward adds
     #: layer.aux_weight * aux_loss to the total (kMoE load balancing)
     has_aux_loss = False
+    #: position-wise over a (B, S, ...) sequence dim: ``apply`` on a
+    #: Q-token suffix equals the full-sequence apply restricted to those
+    #: positions, so the serving-tier incremental decode
+    #: (serve/conf_decode.py) can reuse ``apply`` unchanged. Layers with
+    #: cross-position state instead implement ``decode_step`` (kAttention
+    #: caches K/V, kEmbedding needs absolute positions).
+    decode_positionwise = False
 
     def __init__(self, cfg: LayerConfig, net_partition: str = "kNone"):
         self.cfg = cfg

@@ -133,6 +133,7 @@ class AddLayer(Layer):
     they don't, like standard ResNet type-B shortcuts)."""
 
     TYPE = "kAdd"
+    decode_positionwise = True  # elementwise: serving decode reuses apply
 
     def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
         if len(src_shapes) < 2:

@@ -98,11 +98,23 @@ class EmbeddingLayer(Layer):
         s = tokens.shape[1]
         return params[self.tok][tokens] + params[self.pos][:s]
 
+    def decode_step(self, params, tokens, pos):
+        """Serving-tier incremental apply (serve/conf_decode.py): embed
+        Q tokens at ABSOLUTE positions [pos, pos+Q) — apply()'s ``[:s]``
+        positional slice assumes the window starts at 0, which is only
+        true for the first decode chunk."""
+        q_len = tokens.shape[1]
+        p = jnp.minimum(
+            pos + jnp.arange(q_len), params[self.pos].shape[0] - 1
+        )
+        return params[self.tok][tokens.astype(jnp.int32)] + params[self.pos][p]
+
 
 class LayerNormLayer(Layer):
     """kLayerNorm over the last dim; stats in fp32 under bf16 compute."""
 
     TYPE = "kLayerNorm"
+    decode_positionwise = True  # per-position stats: decode reuses apply
 
     def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
         p = self.cfg.layernorm_param
@@ -200,12 +212,42 @@ class AttentionLayer(Layer):
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, d)
         return o.astype(w.dtype) @ params[self.out]
 
+    def decode_step(self, params, x, cache, pos):
+        """Serving-tier incremental apply: Q new positions at
+        [pos, pos+Q) write their K/V into the (B, H, C, D) caches and
+        attend the whole masked cache via the SAME ``cache_attend`` body
+        the code-API engine runs (models/transformer.py) — the flash /
+        ring training modes are score-footprint optimizations the
+        chunked cache path does not need. -> (out, (new_k, new_v))."""
+        from ..models.transformer import cache_attend
+
+        b, q_len, d = x.shape
+        w = params[self.qkv]
+        qkv = (x.astype(w.dtype) @ w).reshape(
+            b, q_len, 3, self.heads, d // self.heads
+        )
+        q, k, v = (jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3))
+        kc, vc = cache
+        kc = jax.lax.dynamic_update_slice_in_dim(
+            kc, k.astype(kc.dtype), pos, axis=2
+        )
+        vc = jax.lax.dynamic_update_slice_in_dim(
+            vc, v.astype(vc.dtype), pos, axis=2
+        )
+        positions = jnp.broadcast_to(
+            pos + jnp.arange(q_len)[None, :], (b, q_len)
+        )
+        o = cache_attend(q, kc, vc, positions)
+        o = jnp.moveaxis(o, 1, 2).reshape(b, q_len, d)
+        return o.astype(w.dtype) @ params[self.out], (kc, vc)
+
 
 class DenseLayer(Layer):
     """kDense: per-position linear map over the last dim (+ optional
     fused activation). Contrast kInnerProduct, which flattens."""
 
     TYPE = "kDense"
+    decode_positionwise = True  # per-position map: decode reuses apply
 
     def setup(self, src_shapes: Sequence[Shape], batchsize: int) -> Shape:
         p = self.cfg.dense_param

@@ -225,23 +225,45 @@ def lm_apply(
     return logits
 
 
+def cache_attend(q, k_cache, v_cache, positions):
+    """Masked attention of Q queries against a FULL cache — the single
+    attention body every serving path shares (generate()'s prefill and
+    decode scan here, the paged-KV engine's gathered blocks in
+    serve/engine.py, the conf-net decode in serve/conf_decode.py).
+
+    ``q`` (B, H, Q, D) holds queries whose absolute sequence positions
+    are ``positions`` (B, Q); ``k_cache``/``v_cache`` (B, H, C, D) hold
+    the whole (zero-padded) cache. Cache entries beyond a query's
+    position score -1e30, so their softmax weight underflows to exactly
+    0.0 — the cache tail (and any garbage a paged pool gathers there)
+    never moves a bit of the output. Because the math is shared, "paged
+    KV == dense cache" parity is bitwise by construction, not tested
+    luck."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
+    mask = (
+        jnp.arange(k_cache.shape[2])[None, None, None, :]
+        <= positions[:, None, :, None]
+    )
+    s = jnp.where(mask, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v_cache)
+
+
 def _block_step(params, p, x, k_cache, v_cache, pos, cfg):
-    """One transformer block on a SINGLE token (B, 1, d) against the
-    (B, H, max_len, D) caches; returns (x', new_k, new_v) where new_k/v
-    are the caches with position ``pos`` filled. Shares the block body
-    with lm_apply via _block_apply; the decode MoE capacity is E
+    """One transformer block on Q tokens (B, Q, d) against the
+    (B, H, C, D) caches; returns (x', new_k, new_v) where new_k/v are
+    the caches with positions [pos, pos+Q) filled. Q == 1 is the decode
+    step; Q == prompt length (pos == 0) is prefill — ONE body serves
+    both, shared with lm_apply via _block_apply. The MoE capacity is E
     (drop-free, batch-independent)."""
 
     def attend(q, k, v):
         nk = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=2)
         nv = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=2)
-        # masked attention over the cache: positions > pos contribute 0
-        scale = 1.0 / math.sqrt(cfg.head_dim)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, nk) * scale
-        mask = jnp.arange(nk.shape[2])[None, None, None, :] <= pos
-        s = jnp.where(mask, s, -1e30)
-        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), nv)
-        return o, (nk, nv)
+        positions = jnp.broadcast_to(
+            pos + jnp.arange(q.shape[2])[None, :], q.shape[:1] + q.shape[2:3]
+        )
+        return cache_attend(q, nk, nv, positions), (nk, nv)
 
     x, _, (nk, nv) = _block_apply(
         params, p, x, attend, cfg,
@@ -258,17 +280,23 @@ def generate(
     *,
     rng: jax.Array | None = None,
     temperature: float = 0.0,
+    prefill_chunk: int | None = None,
 ) -> jnp.ndarray:
     """Autoregressive decode with a KV cache, TPU-first.
 
     ``prompt`` (B, P) int32 -> (B, P + n_tokens). Greedy when
     ``temperature`` == 0, else softmax sampling at that temperature
     (``rng`` required). The whole decode is ONE jittable program:
-    prefill runs the training forward over the prompt while caching
-    every block's K/V, then a ``lax.scan`` over ``n_tokens`` steps
-    feeds each sampled token back through single-token block steps
-    against the (B, H, max_len, D) caches — static shapes throughout,
-    position handled by masking, no dynamic Python control flow.
+    prefill feeds the prompt through the SAME cached-attention
+    ``_block_step`` body the decode scan uses (in chunks of
+    ``prefill_chunk`` tokens, default min(P, 512), so a long-context
+    prompt never materializes more than a chunk x max_len score
+    tensor), then a ``lax.scan`` over ``n_tokens`` steps feeds each
+    sampled token back through single-token block steps against the
+    (B, H, max_len, D) caches — static shapes throughout, position
+    handled by masking, no dynamic Python control flow. Chunking is
+    bitwise split-invariant, so ``prefill_chunk`` is a memory knob,
+    never a semantics knob.
 
     Beyond-parity extension: the reference is a pre-transformer system
     with no inference path at all (SURVEY §5); this completes the LM
@@ -297,28 +325,32 @@ def generate(
         raise ValueError("generate: sampling (temperature > 0) needs rng")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if prefill_chunk is None:
+        prefill_chunk = max(1, min(plen, 512))
 
-    # ---- prefill: the shared block body over the prompt, caching K/V
-    # (memory-aware attention — dense below the score-footprint
-    # threshold, flash kernel above, so a long-context prompt cannot
-    # materialize an S x S score tensor; MoE at inference capacity E)
-    from ..ops.attention import auto_attention
-
-    x = params["embed/tok"][prompt] + params["embed/pos"][:plen]
-    k_caches, v_caches = [], []
-    pad = ((0, 0), (0, 0), (0, cfg.max_len - plen), (0, 0))
-
-    def prefill_attend(q, k, v):
-        return auto_attention(q, k, v, causal=True), (k, v)
-
-    for i in range(cfg.n_layers):
-        x, _, (k, v) = _block_apply(
-            params, f"blk{i}", x, prefill_attend, cfg,
-            moe_capacity_factor=float(max(cfg.moe_experts, 1)),
+    # ---- prefill: the SAME _block_step body the decode scan (and the
+    # serving engine, serve/engine.py) runs, at Q = chunk length against
+    # zero-initialized caches. Chunking bounds the (B, H, Q, max_len)
+    # score footprint for long prompts — the serving tier's chunked
+    # prefill — and is bitwise chunk-split-invariant: each query attends
+    # the full masked cache regardless of which chunk computed it.
+    shape = (b, cfg.n_heads, cfg.max_len, cfg.head_dim)
+    k_caches = [jnp.zeros(shape) for _ in range(cfg.n_layers)]
+    v_caches = [jnp.zeros(shape) for _ in range(cfg.n_layers)]
+    x_last = None
+    for c0 in range(0, plen, prefill_chunk):
+        n = min(prefill_chunk, plen - c0)
+        x = (
+            params["embed/tok"][prompt[:, c0:c0 + n]]
+            + params["embed/pos"][c0:c0 + n]
         )
-        k_caches.append(jnp.pad(k, pad))
-        v_caches.append(jnp.pad(v, pad))
-    xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+        for i in range(cfg.n_layers):
+            x, k_caches[i], v_caches[i] = _block_step(
+                params, f"blk{i}", x, k_caches[i], v_caches[i],
+                jnp.int32(c0), cfg,
+            )
+        x_last = x
+    xf = _layernorm(x_last, params["ln_f/scale"], params["ln_f/bias"])
     last_logits = (xf @ params["embed/tok"].T)[:, -1]
 
     def sample(logits, key):

@@ -203,6 +203,37 @@ def zero_update_shardings(
     return out
 
 
+def serving_kv_shardings(
+    mesh: Mesh, n_heads: int, *, warn: bool = False
+) -> tuple[NamedSharding, NamedSharding]:
+    """-> (pool_sharding, state_sharding) for the serving engine's paged
+    KV state (serve/engine.py).
+
+    The pools are ``(n_blocks, heads, block_len, head_dim)``: the heads
+    dim shards over the ``model`` axis when it divides evenly — the
+    serving analog of kLayerPartition (each model shard holds its
+    heads' K/V, attention contracts locally, GSPMD reassembles the
+    output exactly as it does for the TP projections) — else the pool
+    replicates, announced like every other indivisible-dim fallback.
+    The block dim NEVER shards: block ids are a global namespace the
+    host allocator hands out, and a table must be resolvable on every
+    shard. Slot-lane state (tokens/pos/live/rng/tables) is tiny and
+    always replicates."""
+    repl = replicated(mesh)
+    nmodel = dict(mesh.shape).get(MODEL_AXIS, 1)
+    if nmodel <= 1:
+        return repl, repl
+    if n_heads % nmodel:
+        if warn:
+            warnings.warn(
+                f"serving: n_heads {n_heads} not divisible by the model "
+                f"axis ({nmodel}); KV pools fall back to replication",
+                stacklevel=2,
+            )
+        return repl, repl
+    return NamedSharding(mesh, P(None, MODEL_AXIS, None, None)), repl
+
+
 def state_shardings(
     param_sh: dict[str, NamedSharding],
     slots: tuple[str, ...],

@@ -1,0 +1,40 @@
+"""Serving tier: continuous-batching inference with a paged KV cache.
+
+The training stack (trainer/, resilience/, obs/) answers the north
+star's "fast and fault-tolerant" half; this package is the "millions of
+users" half — the capability analog of the reference's Server tier, one
+process answering every worker's kGet/kPut concurrently
+(src/server/server.cc; PAPERS.md arxiv 1801.09805 studies exactly this
+request-serving-plane bottleneck).
+
+Three layers, each importable alone:
+
+  ``kv_pool``      block-pool KV allocation: fixed-size per-layer pools
+                   + per-sequence block tables, so thousands of
+                   concurrent streams share device memory instead of
+                   each reserving max_len (vLLM's PagedAttention idea,
+                   sized for this repo's engines).
+  ``engine``       the compute plane: ONE donated, jitted fixed-shape
+                   decode step over a slot-batched state, plus
+                   fixed-shape chunked prefill — admitting/retiring
+                   streams never recompiles. Shares the
+                   ``cache_attend``/``_block_step`` body with
+                   models/transformer.generate, so paged == dense is
+                   bitwise by construction.
+  ``scheduler``    continuous batching: a request queue admitted into
+                   free slots at each decode tick, chunked prefill that
+                   never stalls decode, retirement on EOS/budget, and
+                   admission backpressure when the block pool is
+                   exhausted. Lifecycle events + per-request spans flow
+                   into the PR 6 flight recorder; SIGTERM drains via
+                   the resilience plane (hand back in-flight sequences,
+                   resumable exit 75).
+
+``conf_decode`` extends the same KV-cache serving path to conf-surface
+nets (tools/generate.py); ``tools/serve_bench.py`` is the load harness
+and CI gate.
+"""
+
+from .engine import Engine, EngineConfig  # noqa: F401
+from .kv_pool import BlockAllocator, KVPool  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401

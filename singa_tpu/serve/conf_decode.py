@@ -1,0 +1,165 @@
+"""KV-cache decode for conf-surface nets (the tools/generate.py path).
+
+The conf-driven LM jobs (examples/lm/tinylm*.conf) train through a
+fixed-(B, S) compiled forward; sampling from them used to re-run that
+whole forward for EVERY emitted token — O(S) recompute per token.
+``NetDecoder`` gives a built ``Net`` the serving tier's incremental
+path instead: chunked prefill writes the prompt's K/V into per-
+attention-layer caches, then each new token is one (1, 1) step against
+them — the same ``cache_attend`` body as models/transformer.generate
+and serve/engine.py, reached through each layer's ``decode_step``.
+
+Supported graphs: kSequenceData -> any DAG of position-wise layers
+(``decode_positionwise`` — kLayerNorm/kDense/kAdd today) plus
+kEmbedding/kAttention, into kLMLoss. Anything else (convs, pooling,
+kMoE, pipeline-staged nets) raises ``UnsupportedNet`` and the caller
+falls back to the rolling-buffer recompute decode — a performance
+downgrade, never a behavior change.
+
+Prefill chunks are FIXED (1, C) shapes with a valid count: padding
+tokens write garbage K/V only at positions beyond every live query's
+mask (overwritten by later real writes before anything attends there),
+so one compiled chunk program serves every prompt length and chunking
+is split-invariant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class UnsupportedNet(ValueError):
+    """The graph has a layer the incremental decode cannot serve."""
+
+
+class NetDecoder:
+    """Incremental (KV-cache) token decoder over a built conf Net."""
+
+    def __init__(self, net, *, max_prefill_chunk: int = 32):
+        self.net = net
+        self.chunk = int(max_prefill_chunk)
+        (self.datalayer,) = net.datalayers
+        if len(net.losslayers) != 1:
+            raise UnsupportedNet("decode needs exactly one loss layer")
+        (loss,) = net.losslayers
+        self.head = next(
+            s for s in loss.srclayers if s != self.datalayer.name
+        )
+        self.attn_layers = []
+        for layer in net.layers:
+            if layer.is_datalayer or layer.is_losslayer:
+                continue
+            if hasattr(layer, "decode_step"):
+                if layer.TYPE == "kAttention":
+                    self.attn_layers.append(layer)
+                continue
+            if not layer.decode_positionwise:
+                raise UnsupportedNet(
+                    f"layer {layer.name!r} ({layer.TYPE}) has no "
+                    "incremental decode"
+                )
+        if net.pipeline_plan is not None:
+            raise UnsupportedNet("pipeline-staged nets decode full-window")
+        # cache capacity: the embedding's positional table bounds how far
+        # absolute positions can run; fall back past it
+        embeds = [l for l in net.layers if l.TYPE == "kEmbedding"]
+        if len(embeds) != 1:
+            raise UnsupportedNet("decode needs exactly one kEmbedding")
+        self.embed = embeds[0]
+        self.max_positions = int(
+            net.param_specs()[self.embed.pos].shape[0]
+        )
+        # cache capacity rounds UP to a chunk multiple: a final prefill
+        # chunk's write window [c0, c0+chunk) must always fit, or
+        # dynamic_update_slice would clamp the start and corrupt earlier
+        # positions; the over-allocation tail is permanently masked
+        self.cache_len = -(-self.max_positions // self.chunk) * self.chunk
+        # two compiled programs total: one (1, chunk) prefill shape, one
+        # (1, 1) decode shape — prompt/generation lengths never retrace
+        self._step = jax.jit(self._step_impl, donate_argnums=(2,))
+
+    def init_caches(self, dtype=jnp.float32) -> dict:
+        """{attention layer name: (k, v)} zero caches, (1, H, C, D)."""
+        out = {}
+        for layer in self.attn_layers:
+            shape = layer.out_shape  # (B, S, d) at train shapes
+            d = shape[-1]
+            h = layer.heads
+            out[layer.name] = tuple(
+                jnp.zeros((1, h, self.cache_len, d // h), dtype)
+                for _ in range(2)
+            )
+        return out
+
+    def _step_impl(self, params, tokens, caches, pos, n_valid):
+        """tokens (1, Q) at absolute positions [pos, pos+Q) -> (logits
+        at the last VALID position, new caches). Walks the graph in the
+        same topo order as Net.forward; attention layers thread their
+        cache, everything else applies position-wise."""
+        net = self.net
+        resolved = net.resolve_params(params)
+        acts: dict = {}
+        new_caches = dict(caches)
+        for layer in net.layers:
+            if layer.is_datalayer:
+                acts[layer.name] = tokens
+                continue
+            if layer.is_losslayer:
+                continue
+            inputs = [acts[s] for s in layer.srclayers]
+            if layer.TYPE == "kEmbedding":
+                acts[layer.name] = layer.decode_step(
+                    resolved, inputs[0], pos
+                )
+            elif layer.TYPE == "kAttention":
+                out, new_caches[layer.name] = layer.decode_step(
+                    resolved, inputs[0], caches[layer.name], pos
+                )
+                acts[layer.name] = out
+            else:
+                acts[layer.name] = layer.apply(
+                    resolved, inputs, training=False, rng=None
+                )
+        logits = acts[self.head][0]  # (Q, vocab)
+        last = jnp.take(logits, jnp.maximum(n_valid - 1, 0), axis=0)
+        return last, new_caches
+
+    def generate(self, params, prompt_tokens, n: int, temperature: float,
+                 seed: int) -> list[int]:
+        """prompt ids -> prompt + n generated ids, via chunked prefill +
+        per-token KV-cache decode. Raises UnsupportedNet when the total
+        length exceeds the positional table (the rolling-buffer path
+        slides its window; a KV cache cannot)."""
+        toks = [int(t) for t in prompt_tokens] or [0]
+        if len(toks) + n > self.max_positions:
+            raise UnsupportedNet(
+                f"prompt {len(toks)} + n {n} exceeds the positional "
+                f"table ({self.max_positions}); use the rolling decode"
+            )
+        caches = self.init_caches()
+        rng = jax.random.PRNGKey(seed)
+        out = list(toks)
+        last = None
+        for c0 in range(0, len(toks), self.chunk):
+            chunk = toks[c0:c0 + self.chunk]
+            buf = np.zeros((1, self.chunk), np.int32)
+            buf[0, : len(chunk)] = chunk
+            last, caches = self._step(
+                params, jnp.asarray(buf), caches, jnp.int32(c0),
+                jnp.int32(len(chunk)),
+            )
+        for i in range(n):
+            if temperature <= 0.0:
+                nxt = int(jnp.argmax(last))
+            else:
+                rng, k = jax.random.split(rng)
+                nxt = int(jax.random.categorical(k, last / temperature))
+            out.append(nxt)
+            if i + 1 < n:
+                last, caches = self._step(
+                    params, jnp.full((1, 1), nxt, jnp.int32), caches,
+                    jnp.int32(len(out) - 1), jnp.int32(1),
+                )
+        return out

@@ -1,0 +1,359 @@
+"""Serving engine: fixed-shape prefill/decode over a slot-batched state.
+
+The compute plane of the serving tier. Device state is ONE pytree —
+per-slot token/position/liveness/RNG lanes, per-slot block tables, and
+the per-layer paged K/V pools — and exactly two compiled programs touch
+it:
+
+  decode   ONE donated, jitted step advancing EVERY live slot one
+           token: embed the slots' last tokens, run each transformer
+           block against the pool (scatter the new K/V into each slot's
+           current block, gather each slot's table back to a dense
+           (S, H, cache_len, D) view, ``cache_attend`` masked by
+           position), sample per-slot. Dead slots ride along masked —
+           admitting or retiring a stream flips ``live`` and never
+           changes a shape, so the step NEVER recompiles.
+  prefill  a fixed (1, max_prefill_chunk) chunk of one slot's prompt
+           through the same block body; long prompts take several
+           chunks, so a decode tick is never blocked behind an
+           unbounded prompt. Padding positions write to the trash block
+           and are masked out of every softmax, which makes chunking
+           bitwise split-invariant.
+
+Both programs run the SAME ``_block_apply``/``cache_attend`` body as
+models/transformer.generate — paged-vs-dense parity is shared code, not
+a tolerance. Admission-path work (table updates, first-token sampling)
+is small host-driven device ops, off the decode hot path.
+
+Sharding: pass a mesh and the pools lay their heads dim out over the
+``model`` axis (parallel/shardings.serving_kv_shardings) — the serving
+analog of kLayerPartition; everything else replicates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import (
+    TransformerConfig,
+    _block_apply,
+    _layernorm,
+    cache_attend,
+)
+from .kv_pool import BlockAllocator, KVPool
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-plane knobs (mirrors the ``serving`` model-conf block)."""
+
+    slots: int = 8
+    kv_block_len: int = 16
+    kv_blocks: int = 0          # 0 = dense-equivalent sizing (see KVPool)
+    max_prefill_chunk: int = 64
+
+    @classmethod
+    def from_conf(cls, serving) -> "EngineConfig":
+        """From a parsed ``serving { ... }`` config block (None = defaults)."""
+        if serving is None:
+            return cls()
+        return cls(
+            slots=serving.slots,
+            kv_block_len=serving.kv_block_len,
+            kv_blocks=serving.kv_blocks,
+            max_prefill_chunk=serving.max_prefill_chunk,
+        )
+
+
+class Engine:
+    """Slot-batched continuous-decode engine for the code-API LM."""
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: TransformerConfig,
+        serving: EngineConfig | None = None,
+        *,
+        mesh=None,
+        temperature: float = 0.0,
+    ):
+        self.cfg = cfg
+        self.serving = serving or EngineConfig()
+        self.temperature = float(temperature)
+        self.pool = KVPool.for_model(
+            cfg.max_len, self.serving.kv_block_len,
+            self.serving.kv_blocks, self.serving.slots,
+        )
+        self.allocator = BlockAllocator(self.pool)
+        self.params = params
+        s, mb = self.serving.slots, self.pool.max_blocks_per_seq
+        shape = (
+            self.pool.n_blocks, cfg.n_heads,
+            self.pool.block_len, cfg.head_dim,
+        )
+        pool_sh = state_sh = None
+        if mesh is not None:
+            from ..parallel.shardings import serving_kv_shardings
+
+            pool_sh, state_sh = serving_kv_shardings(mesh, cfg.n_heads)
+        def put(a, sh):
+            return a if sh is None else jax.device_put(a, sh)
+        self.state = {
+            "tokens": put(jnp.zeros((s,), jnp.int32), state_sh),
+            "pos": put(jnp.zeros((s,), jnp.int32), state_sh),
+            "live": put(jnp.zeros((s,), bool), state_sh),
+            "rng": put(
+                jnp.zeros((s, 2), jnp.uint32), state_sh
+            ),
+            "tables": put(jnp.zeros((s, mb), jnp.int32), state_sh),
+            "k": tuple(
+                put(jnp.zeros(shape), pool_sh) for _ in range(cfg.n_layers)
+            ),
+            "v": tuple(
+                put(jnp.zeros(shape), pool_sh) for _ in range(cfg.n_layers)
+            ),
+        }
+        #: blocks owned per slot, freed at retire
+        self._slot_blocks: dict[int, list[int]] = {}
+        self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
+        self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,))
+        # admission-path lane updates fused into one dispatch each —
+        # a request admission must not stall live slots' ticks behind a
+        # storm of single-element device ops
+        self._admit_jit = jax.jit(self._admit_prog, donate_argnums=(0,))
+        self._activate_jit = jax.jit(
+            self._activate_prog, donate_argnums=(0,)
+        )
+        self._retire_jit = jax.jit(self._retire_prog, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+
+    def _gather(self, pool_arr, tables):
+        """(NB, H, BL, D) pool + (S', MB) tables -> (S', H, CL, D) dense
+        per-sequence cache views (CL = MB * BL = the dense cache_len)."""
+        g = pool_arr[tables]                      # (S', MB, H, BL, D)
+        g = jnp.moveaxis(g, 2, 1)                 # (S', H, MB, BL, D)
+        s, h = g.shape[0], g.shape[1]
+        return g.reshape(s, h, self.pool.cache_len, g.shape[-1])
+
+    def _sample(self, logits, keys, live, prev):
+        """Per-slot sampling: greedy at temperature 0 (bit-for-bit the
+        generate() decision rule), else per-slot categorical with each
+        slot's own key stream (slot-independent by construction — a
+        stream's text can never depend on what shares the batch)."""
+        if self.temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            nxt = jax.vmap(
+                lambda k, l: jax.random.categorical(k, l / self.temperature)
+            )(keys, logits).astype(jnp.int32)
+        return jnp.where(live, nxt, prev)
+
+    def _decode(self, params, state):
+        cfg = self.pool
+        tokens, pos, live = state["tokens"], state["pos"], state["live"]
+        mcfg = self.cfg
+        x = (
+            params["embed/tok"][tokens][:, None, :]
+            + params["embed/pos"][pos][:, None, :]
+        )
+        # each slot's write target: its current block, current offset.
+        # Dead lanes route to the trash block explicitly — a slot that
+        # is admitted-but-still-prefilling has a REAL table whose first
+        # block must not be clobbered by its stale decode lane.
+        bid = jnp.take_along_axis(
+            state["tables"], (pos // cfg.block_len)[:, None], axis=1
+        )[:, 0]
+        bid = jnp.where(live, bid, 0)
+        off = pos % cfg.block_len
+        new_k, new_v = [], []
+
+        def mk_attend(i):
+            def attend(q, k, v):
+                kp = state["k"][i].at[bid, :, off].set(k[:, :, 0, :])
+                vp = state["v"][i].at[bid, :, off].set(v[:, :, 0, :])
+                o = cache_attend(
+                    q,
+                    self._gather(kp, state["tables"]),
+                    self._gather(vp, state["tables"]),
+                    pos[:, None],
+                )
+                return o, (kp, vp)
+            return attend
+
+        for i in range(mcfg.n_layers):
+            x, _, (kp, vp) = _block_apply(
+                params, f"blk{i}", x, mk_attend(i), mcfg,
+                moe_capacity_factor=float(max(mcfg.moe_experts, 1)),
+            )
+            new_k.append(kp)
+            new_v.append(vp)
+        xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+        logits = (xf @ params["embed/tok"].T)[:, 0]
+        keys = new_rng = state["rng"]
+        if self.temperature > 0.0:
+            split = jax.vmap(jax.random.split)(state["rng"])
+            new_rng, keys = split[:, 0], split[:, 1]
+        nxt = self._sample(logits, keys, live, tokens)
+        new_state = {
+            **state,
+            "tokens": nxt,
+            "pos": pos + live.astype(jnp.int32),
+            "rng": new_rng,
+            "k": tuple(new_k),
+            "v": tuple(new_v),
+        }
+        return new_state, jnp.where(live, nxt, jnp.int32(-1))
+
+    def _prefill(self, params, state, slot, chunk, pos0, n_valid):
+        """One (1, C) prompt chunk of ``slot`` at absolute positions
+        [pos0, pos0 + C): writes the chunk's K/V into the slot's blocks
+        (padding positions to the trash block) and returns the logits
+        at the last VALID position — garbage only where the mask
+        already guarantees it cannot matter."""
+        cfg, mcfg = self.pool, self.cfg
+        c = chunk.shape[0]
+        p = pos0 + jnp.arange(c)
+        valid = jnp.arange(c) < n_valid
+        # clip the embedding/table lookups for padding positions; their
+        # values are masked, only their indices must stay in range
+        p_safe = jnp.minimum(p, mcfg.max_len - 1)
+        x = (
+            params["embed/tok"][chunk]
+            + params["embed/pos"][p_safe]
+        )[None]
+        row = state["tables"][slot]
+        bid = jnp.where(
+            valid,
+            row[jnp.minimum(p_safe // cfg.block_len, row.shape[0] - 1)],
+            0,
+        )
+        off = p_safe % cfg.block_len
+        new_k, new_v = [], []
+
+        def mk_attend(i):
+            def attend(q, k, v):
+                kp = state["k"][i].at[bid, :, off].set(
+                    jnp.moveaxis(k[0], 1, 0)
+                )
+                vp = state["v"][i].at[bid, :, off].set(
+                    jnp.moveaxis(v[0], 1, 0)
+                )
+                o = cache_attend(
+                    q,
+                    self._gather(kp, row[None]),
+                    self._gather(vp, row[None]),
+                    p[None],
+                )
+                return o, (kp, vp)
+            return attend
+
+        for i in range(mcfg.n_layers):
+            x, _, (kp, vp) = _block_apply(
+                params, f"blk{i}", x, mk_attend(i), mcfg,
+                moe_capacity_factor=float(max(mcfg.moe_experts, 1)),
+            )
+            new_k.append(kp)
+            new_v.append(vp)
+        xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+        logits = (xf[0] @ params["embed/tok"].T)
+        last = jnp.take(logits, jnp.maximum(n_valid - 1, 0), axis=0)
+        return {**state, "k": tuple(new_k), "v": tuple(new_v)}, last
+
+    def _admit_prog(self, state, slot, row):
+        return {
+            **state,
+            "tables": state["tables"].at[slot].set(row),
+            "pos": state["pos"].at[slot].set(0),
+            "live": state["live"].at[slot].set(False),
+        }
+
+    def _activate_prog(self, state, slot, last_logits, plen, seed):
+        rng = jax.random.PRNGKey(seed)
+        k0, rng = jax.random.split(rng)
+        if self.temperature <= 0.0:
+            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        else:
+            first = jax.random.categorical(
+                k0, last_logits / self.temperature
+            ).astype(jnp.int32)
+        return {
+            **state,
+            "tokens": state["tokens"].at[slot].set(first),
+            "pos": state["pos"].at[slot].set(plen),
+            "live": state["live"].at[slot].set(True),
+            "rng": state["rng"].at[slot].set(rng),
+        }, first
+
+    def _retire_prog(self, state, slot):
+        return {
+            **state,
+            "live": state["live"].at[slot].set(False),
+            "tables": state["tables"].at[slot].set(
+                jnp.zeros((self.pool.max_blocks_per_seq,), jnp.int32)
+            ),
+        }
+
+    # ------------------------------------------------------------------
+    # admission-path API (host-driven, one fused dispatch each, never on
+    # the tick path of OTHER slots' decode)
+    # ------------------------------------------------------------------
+
+    def admit(self, slot: int, n_total_tokens: int) -> list[int]:
+        """Allocate ``blocks_for(n_total_tokens)`` blocks to ``slot`` and
+        install its block table (raises PoolExhausted untouched —
+        admission backpressure). The slot stays dead until activate()."""
+        blocks = self.allocator.alloc(self.pool.blocks_for(n_total_tokens))
+        row = np.zeros((self.pool.max_blocks_per_seq,), np.int32)
+        row[: len(blocks)] = blocks
+        self.state = self._admit_jit(
+            self.state, jnp.int32(slot), jnp.asarray(row)
+        )
+        self._slot_blocks[slot] = blocks
+        return blocks
+
+    def prefill_chunk(self, slot: int, tokens: np.ndarray, pos0: int):
+        """Run one prompt chunk (<= max_prefill_chunk tokens) for
+        ``slot``; returns the device logits at the chunk's last valid
+        position (meaningful only for the final chunk)."""
+        c = self.serving.max_prefill_chunk
+        n = len(tokens)
+        if n > c:
+            raise ValueError(f"prefill chunk {n} > max_prefill_chunk {c}")
+        buf = np.zeros((c,), np.int32)
+        buf[:n] = tokens
+        self.state, last = self._prefill_jit(
+            self.params, self.state, jnp.int32(slot), jnp.asarray(buf),
+            jnp.int32(pos0), jnp.int32(n),
+        )
+        return last
+
+    def activate(self, slot: int, last_logits, plen: int, seed: int) -> int:
+        """Sample the first token from the final prefill chunk's logits
+        (the same key discipline as generate(): k0 = first split of the
+        request's key) and flip the slot live. -> the first token."""
+        self.state, first = self._activate_jit(
+            self.state, jnp.int32(slot), last_logits,
+            jnp.int32(plen), jnp.int32(seed),
+        )
+        return int(first)
+
+    def decode(self):
+        """One tick: every live slot advances one token. -> emitted
+        (slots,) int32 device array, -1 on dead slots."""
+        self.state, emitted = self._decode_jit(self.params, self.state)
+        return emitted
+
+    def retire(self, slot: int) -> None:
+        """Free the slot's blocks and kill its lane (its pool contents
+        become reusable garbage, masked wherever gathered)."""
+        self.state = self._retire_jit(self.state, jnp.int32(slot))
+        blocks = self._slot_blocks.pop(slot, None)
+        if blocks:
+            self.allocator.free(blocks)

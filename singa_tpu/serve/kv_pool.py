@@ -1,0 +1,122 @@
+"""Paged KV cache: fixed-size block pools + per-sequence block tables.
+
+A dense serving cache reserves ``slots * max_len`` K/V positions per
+layer no matter how long each stream actually is; at thousands of
+concurrent streams that reservation — not compute — caps concurrency.
+Here device memory holds ONE pool of fixed-size blocks per layer,
+shaped ``(n_blocks, heads, block_len, head_dim)``, and each sequence
+owns an ordered list of block ids (its block table). Admission
+allocates exactly the blocks a request's ``prompt + budget`` needs;
+retirement returns them; a stream's cache view is a gather of its
+table. Blocks are uniform, so the allocator is a free list with zero
+external fragmentation — "fragmentation" can only mean internal slack
+inside a sequence's last block, bounded by ``block_len - 1`` positions.
+
+Block id 0 is reserved as the TRASH block: it is never allocated, table
+rows are initialized to it, and fixed-shape prefill chunks route their
+padding-position writes at it. Gathers may therefore read it freely —
+``models.transformer.cache_attend`` masks every cache entry beyond a
+query's position to -1e30 before the softmax, so trash contents never
+move an output bit (the parity tests pin this).
+
+The allocator is host-side bookkeeping (admission-path work, like the
+reference Server's per-param shard map, src/server/server.cc); the
+pools themselves live in the engine's donated device state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class PoolExhausted(Exception):
+    """No free blocks for an allocation — the scheduler's admission
+    backpressure signal (queued requests wait for a retirement)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVPool:
+    """Static geometry of the paged cache (the device arrays themselves
+    ride the engine's state pytree)."""
+
+    n_blocks: int          # total blocks INCLUDING the reserved trash block
+    block_len: int         # positions per block
+    max_blocks_per_seq: int  # table width = ceil(max_len / block_len)
+
+    @property
+    def cache_len(self) -> int:
+        """Gathered per-sequence cache length (= padded max_len)."""
+        return self.max_blocks_per_seq * self.block_len
+
+    @classmethod
+    def for_model(cls, max_len: int, block_len: int, n_blocks: int = 0,
+                  slots: int = 1) -> "KVPool":
+        """Geometry for a model with ``max_len`` positions. ``n_blocks``
+        0 sizes the pool so every slot can hold a full-length sequence
+        (+ the trash block) — the dense-equivalent upper bound; smaller
+        explicit pools oversubscribe and rely on backpressure."""
+        if block_len < 1:
+            raise ValueError(f"kv_block_len must be >= 1, got {block_len}")
+        if max_len % block_len:
+            raise ValueError(
+                f"kv_block_len {block_len} must divide max_len {max_len} "
+                "(keeps the gathered cache length equal to the dense "
+                "cache, so paged == dense stays bitwise)"
+            )
+        per_seq = max_len // block_len
+        if not n_blocks:
+            n_blocks = slots * per_seq + 1
+        if n_blocks < per_seq + 1:
+            raise ValueError(
+                f"kv_blocks {n_blocks} cannot hold even one full "
+                f"sequence ({per_seq} blocks) plus the trash block"
+            )
+        return cls(n_blocks, block_len, per_seq)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks a sequence of ``n_tokens`` total positions needs."""
+        return -(-max(1, n_tokens) // self.block_len)
+
+
+class BlockAllocator:
+    """Free-list allocator over a pool's block ids (block 0 reserved)."""
+
+    def __init__(self, pool: KVPool):
+        self.pool = pool
+        self._free = list(range(pool.n_blocks - 1, 0, -1))  # pop() -> 1,2,..
+        self._owned: set[int] = set()
+        #: high-water mark of blocks in use (serve_bench's occupancy row)
+        self.peak_used = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._owned)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """-> ``n`` block ids; raises PoolExhausted leaving the free
+        list untouched (the all-or-nothing contract admission needs)."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"({len(self._owned)} in use of {self.pool.n_blocks - 1})"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        self._owned.update(out)
+        self.peak_used = max(self.peak_used, len(self._owned))
+        return out
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b not in self._owned:
+                raise ValueError(
+                    f"free of block {b} not handed out by this allocator"
+                )
+            self._owned.discard(b)
+            self._free.append(b)

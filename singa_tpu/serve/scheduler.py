@@ -1,0 +1,324 @@
+"""Continuous batching: admit/retire request streams at each decode tick.
+
+The control plane of the serving tier. The reference's Server answered
+every worker's kGet/kPut from one process (src/server/server.cc); this
+scheduler answers every client's generation request from one engine:
+
+  - a FIFO request queue; at each tick, queued prompts are admitted
+    into free slots while the block pool can cover their whole
+    ``prompt + budget`` (all-or-nothing, so a live stream can never
+    strand mid-generation on an exhausted pool) — an allocation that
+    does not fit applies ADMISSION backpressure: the request waits for
+    a retirement, it is never dropped;
+  - admitted prompts prefill in fixed chunks, one chunk per request per
+    tick, so a long prompt shares the host loop with live decode
+    instead of stalling it;
+  - every live slot advances one token per tick through the engine's
+    single fixed-shape decode program; EOS or an exhausted budget
+    retires the slot (blocks freed, available to the next admit — the
+    continuous part of continuous batching);
+  - a SIGTERM'd serving host drains via the resilience plane: the
+    serve loop observes ``PreemptionHandler.requested`` at a tick
+    boundary, hands every in-flight sequence back (recorded, with its
+    partial output), and the host exits EXIT_RESUMABLE (75) — the same
+    discipline as a training drain.
+
+Lifecycle events (``request_admit`` / ``prefill`` / ``decode_tick`` /
+``retire`` / ``evict`` / ``backpressure`` / ``drain``) and per-request
+spans flow into the PR 6 flight recorder, so
+``tools/trace.py --summarize`` reports serving p50/p99 and tokens/sec
+with no serving-specific plumbing.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+
+import numpy as np
+
+from .engine import Engine
+from .kv_pool import PoolExhausted
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle bookkeeping."""
+
+    rid: int
+    prompt: np.ndarray            # (P,) int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos: int | None = None
+
+    # runtime (owned by the scheduler)
+    status: str = "queued"        # queued|prefill|decoding|done|evicted
+    slot: int | None = None
+    tokens: list = dataclasses.field(default_factory=list)
+    enqueue_mono: float = 0.0
+    admit_wall: float = 0.0
+    admit_mono: float = 0.0
+    first_token_mono: float = 0.0
+    finish_mono: float = 0.0
+    _prefilled: int = 0
+
+    @property
+    def latency_s(self) -> float:
+        """Admit -> finish wall seconds (0 until finished)."""
+        return max(0.0, self.finish_mono - self.admit_mono)
+
+
+class Scheduler:
+    """Continuous-batching loop over one Engine."""
+
+    def __init__(self, engine: Engine, *, recorder=None, preemption=None,
+                 log=lambda s: None):
+        self.engine = engine
+        self.recorder = recorder
+        self.preemption = preemption
+        self.log = log
+        self._queue: collections.deque[Request] = collections.deque()
+        self._slot_req: dict[int, Request] = {}
+        self.ticks = 0
+        self.tokens_emitted = 0
+        self.backpressure_ticks = 0
+        #: sum over ticks of live (decoding) slots — occupancy reporting
+        self._live_ticks = 0
+        #: wall seconds / tokens over FULL-occupancy decode ticks only:
+        #: the steady-state capacity number (admission work is a
+        #: per-request constant; a long-running server lives here)
+        self.full_tick_s = 0.0
+        self.full_tick_tokens = 0
+        self.finished: list[Request] = []
+
+    # -- client side ----------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if req.temperature != self.engine.temperature:
+            raise ValueError(
+                f"request {req.rid}: temperature {req.temperature} != "
+                f"engine temperature {self.engine.temperature} (one "
+                "compiled decode program serves every slot)"
+            )
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.engine.cfg.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + budget "
+                f"{req.max_new_tokens} exceeds max_len "
+                f"{self.engine.cfg.max_len}"
+            )
+        req.prompt = np.asarray(req.prompt, np.int32)
+        req.enqueue_mono = time.perf_counter()
+        req.status = "queued"
+        self._queue.append(req)
+
+    @property
+    def in_flight(self) -> list[Request]:
+        return list(self._slot_req.values())
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue or self._slot_req)
+
+    def _event(self, kind: str, **payload) -> None:
+        if self.recorder is not None:
+            self.recorder.event(kind, step=self.ticks, **payload)
+
+    # -- the tick -------------------------------------------------------
+
+    def _admit_some(self) -> None:
+        free = [
+            s for s in range(self.engine.serving.slots)
+            if s not in self._slot_req
+        ]
+        stalled = False
+        while self._queue and free:
+            req = self._queue[0]
+            try:
+                blocks = self.engine.admit(
+                    free[0], len(req.prompt) + req.max_new_tokens
+                )
+            except PoolExhausted:
+                stalled = True
+                break
+            self._queue.popleft()
+            slot = free.pop(0)
+            self._slot_req[slot] = req
+            req.slot = slot
+            req.status = "prefill"
+            req._prefilled = 0
+            # a handed-back (drained) request restarts from scratch on
+            # re-admission: its partial output was delivered at evict
+            # time, regeneration must not append to it
+            req.tokens = []
+            req.admit_wall = time.time()
+            req.admit_mono = time.perf_counter()
+            self._event(
+                "request_admit", rid=req.rid, slot=slot,
+                prompt_len=int(len(req.prompt)), blocks=len(blocks),
+                queued_s=round(req.admit_mono - req.enqueue_mono, 6),
+            )
+        if stalled:
+            self.backpressure_ticks += 1
+            self._event(
+                "backpressure",
+                queued=len(self._queue),
+                free_blocks=self.engine.allocator.free_blocks,
+            )
+
+    def _prefill_some(self) -> None:
+        # one chunk per prefilling request per tick: decode never waits
+        # behind more than slots * one chunk of prompt work
+        for slot in sorted(self._slot_req):
+            req = self._slot_req[slot]
+            if req.status != "prefill":
+                continue
+            n = min(
+                self.engine.serving.max_prefill_chunk,
+                len(req.prompt) - req._prefilled,
+            )
+            last = self.engine.prefill_chunk(
+                slot, req.prompt[req._prefilled:req._prefilled + n],
+                req._prefilled,
+            )
+            req._prefilled += n
+            self._event(
+                "prefill", rid=req.rid, slot=slot, tokens=int(n),
+                done=int(req._prefilled), of=int(len(req.prompt)),
+            )
+            if req._prefilled >= len(req.prompt):
+                first = self.engine.activate(
+                    slot, last, len(req.prompt), req.seed
+                )
+                req.tokens.append(first)
+                req.status = "decoding"
+                req.first_token_mono = time.perf_counter()
+                self._check_done(slot, req, first)
+
+    def _check_done(self, slot: int, req: Request, tok: int) -> bool:
+        if (req.eos is not None and tok == req.eos) or (
+            len(req.tokens) >= req.max_new_tokens
+        ):
+            self._finish(slot, req, "eos" if req.eos is not None
+                         and tok == req.eos else "budget")
+            return True
+        return False
+
+    def _finish(self, slot: int, req: Request, reason: str) -> None:
+        self.engine.retire(slot)
+        del self._slot_req[slot]
+        req.status = "done"
+        req.finish_mono = time.perf_counter()
+        self.finished.append(req)
+        self._event(
+            "retire", rid=req.rid, slot=slot, reason=reason,
+            tokens=int(len(req.tokens)),
+            latency_s=round(req.latency_s, 6),
+        )
+        if self.recorder is not None:
+            self.recorder.record_span(
+                "request", req.admit_wall, req.latency_s,
+                track="requests", steps=len(req.tokens),
+            )
+
+    def tick(self) -> int:
+        """One scheduling round: retire happens inline as tokens land,
+        admit fills freed slots, prefill advances one chunk each, then
+        every live slot decodes one token. -> tokens emitted."""
+        self._admit_some()
+        self._prefill_some()
+        decoding = {
+            s: r for s, r in self._slot_req.items() if r.status == "decoding"
+        }
+        emitted_n = 0
+        if decoding:
+            t0w, t0 = time.time(), time.perf_counter()
+            emitted = np.asarray(self.engine.decode())
+            dur = time.perf_counter() - t0
+            for slot, req in sorted(decoding.items()):
+                tok = int(emitted[slot])
+                req.tokens.append(tok)
+                emitted_n += 1
+                self._check_done(slot, req, tok)
+            self._live_ticks += len(decoding)
+            self.tokens_emitted += emitted_n
+            if len(decoding) == self.engine.serving.slots:
+                self.full_tick_s += dur
+                self.full_tick_tokens += emitted_n
+            if self.recorder is not None:
+                self.recorder.record_span(
+                    "decode_tick", t0w, dur,
+                    track="serving", steps=emitted_n,
+                )
+            self._event(
+                "decode_tick", live=len(decoding), emitted=emitted_n,
+                blocks_used=self.engine.allocator.used_blocks,
+            )
+        self.ticks += 1
+        return emitted_n
+
+    # -- loops ----------------------------------------------------------
+
+    def serve(self, max_ticks: int = 10 ** 9):
+        """Tick until idle (or ``max_ticks``). Observes the resilience
+        plane at every tick boundary: a requested preemption turns into
+        a drain — the accounting dict return value; None means the
+        queue ran dry normally. The check runs FIRST each round, so a
+        signal arriving mid-tick drains at the next boundary —
+        in-flight device work always completes, exactly the training
+        loop's step-boundary discipline."""
+        while self.busy and self.ticks < max_ticks:
+            if self.preemption is not None and self.preemption.requested:
+                return self.drain(self.preemption.reason or "preempted")
+            self.tick()
+        return None
+
+    def drain(self, reason: str) -> dict:
+        """Preemption drain: hand every in-flight sequence back (partial
+        output recorded, blocks freed, request re-queued at the front so
+        a relaunch finishes it first) and report the accounting the
+        launcher needs. The caller exits EXIT_RESUMABLE (75)."""
+        self._event(
+            "drain", reason=reason,
+            in_flight=len(self._slot_req), queued=len(self._queue),
+        )
+        handed_back = []
+        for slot in sorted(self._slot_req):
+            req = self._slot_req[slot]
+            self.engine.retire(slot)
+            req.status = "evicted"
+            self._event(
+                "evict", rid=req.rid, slot=slot, state="in_flight",
+                tokens_done=int(len(req.tokens)),
+                prefilled=int(req._prefilled),
+            )
+            handed_back.append(req)
+        for req in reversed(handed_back):
+            self._queue.appendleft(req)
+        self._slot_req.clear()
+        if self.recorder is not None:
+            self.recorder.flush()
+        return {
+            "reason": reason,
+            "handed_back": [
+                {"rid": r.rid, "tokens_done": len(r.tokens)}
+                for r in handed_back
+            ],
+            "queued": [r.rid for r in self._queue],
+            "finished": [r.rid for r in self.finished],
+        }
+
+    # -- reporting ------------------------------------------------------
+
+    def occupancy(self) -> dict:
+        ticks = max(1, self.ticks)
+        return {
+            "slot_occupancy": round(
+                self._live_ticks / (ticks * self.engine.serving.slots), 4
+            ),
+            "kv_blocks_peak": self.engine.allocator.peak_used,
+            "kv_blocks_total": self.engine.pool.n_blocks - 1,
+            "backpressure_ticks": self.backpressure_ticks,
+        }

@@ -9,14 +9,16 @@ sample loop for the byte-level LM jobs (examples/lm/tinylm*.conf).
         -checkpoint ws/checkpoints/step_2000.npz \
         -prompt "hello " -n 64 [-temperature 0.8] [-seed 0]
 
-Design: the net's compiled forward has a fixed sequence length S (the
-conf's training window), so decode keeps a rolling (1, S) token buffer
-— the prompt left-aligned, the tail zero-padded. Causal attention makes
-the padding invisible to every live position, and each step reads the
-logits at the last live position from the net's "head"-layer activation
-(return_acts). One XLA program serves every step (same shapes, jit
-cache hit); the models/transformer.generate path is the KV-cache fast
-variant for the code API.
+Design: decode rides the serving tier's KV-cache path
+(serve/conf_decode.NetDecoder) whenever the net's graph supports
+incremental apply and the requested length fits the positional table:
+chunked prefill writes the prompt's K/V once, then every emitted token
+is one (1, 1) cached step instead of a full (1, S) forward — O(1)
+recompute per token where the old rolling-buffer decode paid O(S).
+Unsupported graphs (convs, kMoE, staged pipelines) and
+beyond-the-window generations fall back to that rolling decode: a
+(1, S) buffer, prompt left-aligned, logits read at the last live
+position via return_acts — slower, never wrong.
 """
 
 from __future__ import annotations
@@ -67,8 +69,38 @@ def _ensure_shard(cfg, vocab: int) -> None:
 
 
 def generate_from_net(net, params, prompt_tokens, n: int,
-                      temperature: float, seed: int) -> list[int]:
-    """Rolling-buffer greedy/temperature decode over the conf net."""
+                      temperature: float, seed: int,
+                      log=lambda s: None, serving=None) -> list[int]:
+    """Decode over the conf net: KV-cache path when the graph supports
+    it (serve/conf_decode.py), rolling-buffer recompute otherwise.
+    ``serving`` is the job's parsed ``serving { }`` config block (None =
+    defaults); its ``max_prefill_chunk`` sizes the prefill chunks here —
+    the slot/kv-pool knobs configure the slot-batched Engine, which a
+    single-stream CLI sample does not build."""
+    from ..serve.conf_decode import NetDecoder, UnsupportedNet
+    from ..serve.engine import EngineConfig
+
+    try:
+        dec = NetDecoder(
+            net,
+            max_prefill_chunk=EngineConfig.from_conf(
+                serving
+            ).max_prefill_chunk,
+        )
+        return dec.generate(params, prompt_tokens, n, temperature, seed)
+    except UnsupportedNet as e:
+        log(f"generate: KV-cache decode unavailable ({e}); "
+            "falling back to rolling-buffer recompute")
+    return rolling_generate_from_net(
+        net, params, prompt_tokens, n, temperature, seed
+    )
+
+
+def rolling_generate_from_net(net, params, prompt_tokens, n: int,
+                              temperature: float, seed: int) -> list[int]:
+    """Rolling-buffer greedy/temperature decode over the conf net (the
+    pre-serving-tier path; kept as the universal fallback and as the
+    reference oracle the KV-cache path is tested against)."""
     import jax
     import jax.numpy as jnp
 
@@ -134,7 +166,8 @@ def main(argv=None) -> int:
     params = {k: jnp.asarray(v) for k, v in params.items()}
     prompt = [b % vocab for b in args.prompt.encode()]
     toks = generate_from_net(
-        net, params, prompt, args.n, args.temperature, args.seed
+        net, params, prompt, args.n, args.temperature, args.seed,
+        log=lambda s: print(s, file=sys.stderr), serving=cfg.serving,
     )
     if args.raw:
         print(" ".join(str(t) for t in toks))

@@ -84,6 +84,8 @@ _TRACK_TIDS = {
     "feeder": 2,
     "stager": 3,
     "ckpt_writer": 4,
+    "serving": 5,
+    "requests": 6,
     "events": 9,
 }
 
@@ -178,8 +180,20 @@ def summarize(records: list[dict]) -> dict:
     # undercount relative to per-step dispatch
     per_step_ms: list[float] = []
     comm_ms: list[float] = []
+    # serving tier: per-request latency spans + decode-tick spans
+    # (serve/scheduler.py records both), summarized like step times
+    request_ms: list[float] = []
+    tick_s = 0.0
+    tick_tokens = 0
+    ticks = 0
     phase_totals: dict[str, float] = {}
     for s in spans:
+        if s.get("track") == "requests":
+            request_ms.append(float(s.get("dur", 0.0)) * 1e3)
+        elif s.get("track") == "serving":
+            tick_s += float(s.get("dur", 0.0))
+            tick_tokens += int(s.get("steps", 0))
+            ticks += 1
         if s.get("track") != "phases":
             phase_totals[s.get("track", "?")] = (
                 phase_totals.get(s.get("track", "?"), 0.0) + s.get("dur", 0.0)
@@ -197,6 +211,7 @@ def summarize(records: list[dict]) -> dict:
             comm_ms.append(dur / n * 1e3)
     per_step_ms.sort()
     comm_ms.sort()
+    request_ms.sort()
 
     train_t = phase_totals.get("train", 0.0)
     data_t = phase_totals.get("data", 0.0)
@@ -296,6 +311,27 @@ def summarize(records: list[dict]) -> dict:
         },
         "fired_faults": faults,
         "max_rank_skew_s": round(skew, 4),
+        # serving tier (None unless serving spans/events are present):
+        # request-latency percentiles from per-request spans, decode
+        # throughput from tick spans, lifecycle counts from events
+        "serving": {
+            "request_latency_ms": {
+                "p50": round(_percentile(request_ms, 0.50), 2),
+                "p99": round(_percentile(request_ms, 0.99), 2),
+                "n": len(request_ms),
+            },
+            "decode_ticks": ticks,
+            "tokens": tick_tokens + len(request_ms),
+            "tokens_per_s": round(tick_tokens / tick_s, 1)
+            if tick_s > 0
+            else 0.0,
+            "admitted": counts.get("request_admit", 0),
+            "retired": counts.get("retire", 0),
+            "evicted": counts.get("evict", 0),
+            "backpressure": counts.get("backpressure", 0),
+        }
+        if (request_ms or ticks or counts.get("request_admit"))
+        else None,
     }
 
 

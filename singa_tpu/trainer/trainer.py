@@ -1299,6 +1299,36 @@ class Trainer:
         0's running stats)."""
         return self.buffers
 
+    def _eval_batches(self, net: Net, nsteps: int):
+        """Yield ``nsteps`` eval batches. Uncached eval streams ride a
+        bounded BurstFeeder (the serving tier's request-batching
+        machinery applied to the eval plane — the ROADMAP's eval-stream
+        feeder gap): batch k+1 assembles + device_puts on a worker
+        thread while eval step k runs, and exactly ``nsteps`` batches
+        are drawn, so stream positions advance identically to the
+        synchronous path (resume/rollback replay stays exact). Cached
+        nets and prefetch-off jobs keep the direct path."""
+        if self._cached or not self._prefetch_input:
+            for _ in range(nsteps):
+                yield self._next_batch(net)
+            return
+        from ..data.device_prefetch import BurstFeeder
+
+        rec = self.telemetry
+
+        def assemble():
+            if rec is None:
+                return self._assemble_host_batch(net)
+            with rec.span("assemble_batch", track="feeder"):
+                return self._assemble_host_batch(net)
+
+        feeder = BurstFeeder(assemble, nsteps)
+        try:
+            for _ in range(nsteps):
+                yield feeder.next()
+        finally:
+            feeder.reset()
+
     def _make_eval_chunk_fn(self, net: Net, nsteps: int) -> Callable:
         """One compiled program for a whole eval cadence: scan nsteps
         batches (on-device index math, like _make_chunk_fn) and sum the
@@ -1361,10 +1391,8 @@ class Trainer:
         else:
             fn = self._eval_step_for(net)
             with self.timers.phase("eval", steps=nsteps):
-                for _ in range(nsteps):
-                    perf.update(
-                        fn(eval_params, eval_buffers, self._next_batch(net))
-                    )
+                for batch in self._eval_batches(net, nsteps):
+                    perf.update(fn(eval_params, eval_buffers, batch))
         avg = perf.avg()
         self.log(f"step {step}: {phase} {perf.to_string(avg)}")
         if self.telemetry is not None:
