@@ -493,6 +493,52 @@ def bench_lm_d128_serve():
     }
 
 
+def bench_lm_d128_spec():
+    """Speculative decode on the serving shape: the same engine as
+    `lm_d128_serve` with n-gram drafting at k=4 on the
+    drafting-friendly repeat workload vs its own one-token tick
+    (`base_tokens_per_s`). `tokens_per_s` is the row value;
+    `acceptance_rate` and `tokens_per_tick` are the amortization
+    numbers a regression in either the drafter or the verify program
+    would move; `spec_machinery_ratio` is the compiled-cost ratio of
+    the zero-draft verify tick over the decode tick (the
+    speculation-when-it-buys-nothing overhead, ~1.0 by construction).
+    On this CPU host decode is compute-bound so `spec_speedup` < 1 is
+    expected (the (k+1)-wide verify pays real FLOPs a
+    weight-streaming-bound accelerator would not) — the row exists to
+    pin acceptance, identity (token_mismatches == 0), and machinery,
+    which is exactly what serve_bench's or-gate enforces in CI."""
+    import io
+    from contextlib import redirect_stdout
+
+    from singa_tpu.tools import serve_bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        serve_bench.main([
+            "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+            "--requests", "12", "--max_new", "32", "--no_gate",
+            "--speculate_k", "4", "--workload", "repeat",
+        ])
+    r = json.loads(buf.getvalue().strip().splitlines()[-1])
+    return {
+        "name": "lm_d128_spec",
+        "value": r["tokens_per_s"],
+        "unit": "tokens/sec",
+        "tokens_per_s": r["tokens_per_s"],
+        "base_tokens_per_s": r.get("base_tokens_per_s"),
+        "spec_speedup": r.get("spec_speedup"),
+        "acceptance_rate": r.get("acceptance_rate"),
+        "tokens_per_tick": r.get("tokens_per_tick"),
+        "spec_machinery_ratio": r.get("spec_machinery_ratio"),
+        "spec_k": r.get("spec_k"),
+        "p50_ms": r["p50_ms"],
+        "p99_ms": r["p99_ms"],
+        "token_mismatches": r.get("token_mismatches"),
+        "method": "serve_bench speculative workload (request wall clock)",
+    }
+
+
 BENCHES = (
     ("mnist_mlp", bench_mnist_mlp),
     ("cifar_alexnet", bench_cifar_alexnet),
@@ -504,6 +550,7 @@ BENCHES = (
     ("lm_d128_zero", bench_lm_d128_zero),
     ("lm_d128_q8", bench_lm_d128_q8),
     ("lm_d128_serve", bench_lm_d128_serve),
+    ("lm_d128_spec", bench_lm_d128_spec),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),

@@ -690,6 +690,34 @@ class GradCommConfig(Message):
     }
 
 
+SPEC_DRAFTERS = ("ngram", "null")
+
+
+class SpeculateConfig(Message):
+    """singa-tpu extension: speculative multi-token decode for the
+    serving tier (serve/speculate.py). ``k`` draft tokens per live slot
+    per tick are proposed by a model-free ``drafter`` (``ngram`` =
+    longest-suffix prompt lookup against the sequence's own
+    prompt+emitted tokens; ``null`` = never proposes — the machinery
+    probe) and scored in ONE fixed-shape batched verify pass; greedy
+    acceptance takes the longest matching prefix plus the bonus token,
+    and a masked KV rewind keeps the paged cache bitwise what
+    sequential one-token decode would have written. Token streams are
+    identical to non-speculative greedy by construction — speculation
+    changes *when* tokens appear, never *which*. ``k: 0`` (default)
+    disables speculation (the one-token decode tick). Speculation is
+    greedy-only per slot: a temperature > 0 slot rides the verify tick
+    with zero drafts (one sampled token per tick)."""
+
+    FIELDS = {
+        # draft tokens proposed per live greedy slot per tick; the
+        # verify program scores (slots, k+1) positions in one forward
+        "k": Field("int", 0),
+        # draft source: "ngram" prompt-lookup, "null" (machinery probe)
+        "drafter": Field("enum", "ngram", enum=SPEC_DRAFTERS),
+    }
+
+
 class ServingConfig(Message):
     """singa-tpu extension: the serving tier (singa_tpu/serve/) — the
     capability analog of the reference's Server tier (one process
@@ -714,6 +742,8 @@ class ServingConfig(Message):
         "kv_blocks": Field("int", 0),
         # max prompt tokens prefilled per request per tick
         "max_prefill_chunk": Field("int", 64),
+        # speculative multi-token decode (absent = one-token ticks)
+        "speculate": Field("message", message=SpeculateConfig),
     }
 
 

@@ -199,6 +199,18 @@ def _block_apply(params, p, x, attend, cfg, mesh=None,
     return x, aux, extra
 
 
+def lm_head(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Final layernorm + tied-embedding projection — the ONE LM head
+    every forward shares (lm_apply, generate()'s prefill and decode
+    scan, and the serving engine's decode/prefill/verify programs in
+    serve/engine.py). Shared for the same reason ``_block_apply`` is:
+    the speculative verify step's per-position logits must be the SAME
+    head math as the one-token decode tick, so acceptance decisions
+    cannot drift from what sequential decode would have emitted."""
+    xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
+    return xf @ params["embed/tok"].T
+
+
 def lm_apply(
     params: dict,
     tokens: jnp.ndarray,
@@ -218,8 +230,7 @@ def lm_apply(
     for i in range(cfg.n_layers):
         x, aux, _ = _block_apply(params, f"blk{i}", x, attend, cfg, mesh)
         aux_total = aux_total + aux
-    x = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
-    logits = x @ params["embed/tok"].T
+    logits = lm_head(params, x)
     if return_aux:
         return logits, aux_total
     return logits
@@ -350,8 +361,7 @@ def generate(
                 jnp.int32(c0), cfg,
             )
         x_last = x
-    xf = _layernorm(x_last, params["ln_f/scale"], params["ln_f/bias"])
-    last_logits = (xf @ params["embed/tok"].T)[:, -1]
+    last_logits = lm_head(params, x_last)[:, -1]
 
     def sample(logits, key):
         if temperature <= 0.0:
@@ -377,8 +387,7 @@ def generate(
             )
             new_ks.append(nk)
             new_vs.append(nv)
-        xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
-        logits = (xf @ params["embed/tok"].T)[:, 0]
+        logits = lm_head(params, x)[:, 0]
         nxt = sample(logits, key)
         return (nxt, pos + 1, new_ks, new_vs), token
 

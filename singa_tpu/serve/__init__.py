@@ -30,6 +30,15 @@ Three layers, each importable alone:
                    the resilience plane (hand back in-flight sequences,
                    resumable exit 75).
 
+``speculate`` adds model-free multi-token decode on top: an n-gram
+prompt-lookup drafter proposes k tokens per live slot per tick, the
+engine's fixed-shape VERIFY program scores all (slots, k+1) positions
+in one forward (one weight stream for up to k+1 emitted tokens — the
+throughput answer to decode being weight-streaming-bound), and a
+masked KV rewind keeps the paged cache bitwise what sequential
+one-token decode would have written. Token streams are identical to
+non-speculative greedy by construction.
+
 ``conf_decode`` extends the same KV-cache serving path to conf-surface
 nets (tools/generate.py); ``tools/serve_bench.py`` is the load harness
 and CI gate.
@@ -38,3 +47,4 @@ and CI gate.
 from .engine import Engine, EngineConfig  # noqa: F401
 from .kv_pool import BlockAllocator, KVPool  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
+from .speculate import NGramDrafter, NullDrafter, make_drafter  # noqa: F401

@@ -19,11 +19,30 @@ it:
            unbounded prompt. Padding positions write to the trash block
            and are masked out of every softmax, which makes chunking
            bitwise split-invariant.
+  verify   the speculative tick (spec_k > 0, serve/speculate.py): ONE
+           donated fixed-shape pass scoring every live slot's current
+           token PLUS its k drafted candidates — (slots, k+1) query
+           positions through the paged pool, the prefill chunk shape
+           turned sideways. Greedy acceptance takes the longest prefix
+           of the draft matching the model's own argmax continuations
+           plus one bonus token (up to k+1 tokens per slot per weight
+           stream); a masked KV REWIND keeps only positions sequential
+           decode would have written — the pool after any accept/
+           reject pattern is bitwise what one-token ticks leave.
 
-Both programs run the SAME ``_block_apply``/``cache_attend`` body as
-models/transformer.generate — paged-vs-dense parity is shared code, not
-a tolerance. Admission-path work (table updates, first-token sampling)
-is small host-driven device ops, off the decode hot path.
+All programs run the SAME ``_block_apply``/``cache_attend``/``lm_head``
+body as models/transformer.generate — paged-vs-dense parity AND
+speculative-vs-sequential parity are shared code, not a tolerance.
+Admission-path work (table updates, first-token sampling) is small
+host-driven device ops, off the decode hot path.
+
+Sampling is a per-slot TEMPERATURE LANE: a (slots,) array + masked
+categorical, so mixed sampling configs (greedy and temperature slots
+side by side) share one compiled program — admitting a temperature
+request next to greedy ones never recompiles. Speculation is
+greedy-only per slot: a temperature > 0 slot rides the verify tick
+with zero drafts (it emits its one sampled token per tick; its key
+discipline — one split per emitted token — is identical either way).
 
 Sharding: pass a mesh and the pools lay their heads dim out over the
 ``model`` axis (parallel/shardings.serving_kv_shardings) — the serving
@@ -41,8 +60,8 @@ import numpy as np
 from ..models.transformer import (
     TransformerConfig,
     _block_apply,
-    _layernorm,
     cache_attend,
+    lm_head,
 )
 from .kv_pool import BlockAllocator, KVPool
 
@@ -55,17 +74,25 @@ class EngineConfig:
     kv_block_len: int = 16
     kv_blocks: int = 0          # 0 = dense-equivalent sizing (see KVPool)
     max_prefill_chunk: int = 64
+    #: draft tokens per live greedy slot per speculative tick
+    #: (``serving { speculate { k } }``); 0 = one-token decode ticks
+    spec_k: int = 0
+    #: drafter name (serve/speculate.py DRAFTERS)
+    spec_drafter: str = "ngram"
 
     @classmethod
     def from_conf(cls, serving) -> "EngineConfig":
         """From a parsed ``serving { ... }`` config block (None = defaults)."""
         if serving is None:
             return cls()
+        spec = serving.speculate
         return cls(
             slots=serving.slots,
             kv_block_len=serving.kv_block_len,
             kv_blocks=serving.kv_blocks,
             max_prefill_chunk=serving.max_prefill_chunk,
+            spec_k=spec.k if spec is not None else 0,
+            spec_drafter=spec.drafter if spec is not None else "ngram",
         )
 
 
@@ -106,6 +133,9 @@ class Engine:
             "tokens": put(jnp.zeros((s,), jnp.int32), state_sh),
             "pos": put(jnp.zeros((s,), jnp.int32), state_sh),
             "live": put(jnp.zeros((s,), bool), state_sh),
+            # per-slot sampling temperature lane: one compiled program
+            # serves mixed sampling configs (0 = greedy, masked select)
+            "temp": put(jnp.zeros((s,), jnp.float32), state_sh),
             "rng": put(
                 jnp.zeros((s, 2), jnp.uint32), state_sh
             ),
@@ -121,6 +151,7 @@ class Engine:
         self._slot_blocks: dict[int, list[int]] = {}
         self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
         self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,))
+        self._verify_jit = jax.jit(self._verify, donate_argnums=(1,))
         # admission-path lane updates fused into one dispatch each —
         # a request admission must not stall live slots' ticks behind a
         # storm of single-element device ops
@@ -142,18 +173,27 @@ class Engine:
         s, h = g.shape[0], g.shape[1]
         return g.reshape(s, h, self.pool.cache_len, g.shape[-1])
 
-    def _sample(self, logits, keys, live, prev):
-        """Per-slot sampling: greedy at temperature 0 (bit-for-bit the
-        generate() decision rule), else per-slot categorical with each
-        slot's own key stream (slot-independent by construction — a
-        stream's text can never depend on what shares the batch)."""
-        if self.temperature <= 0.0:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            nxt = jax.vmap(
-                lambda k, l: jax.random.categorical(k, l / self.temperature)
-            )(keys, logits).astype(jnp.int32)
+    def _sample(self, logits, keys, temps, live, prev):
+        """Per-slot sampling through the temperature LANE: greedy argmax
+        where a slot's temperature is 0 (bit-for-bit the generate()
+        decision rule), per-slot categorical with the slot's own key
+        stream otherwise — a masked select, so one compiled program
+        serves any mix (slot-independent by construction: a stream's
+        text can never depend on what shares the batch)."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = jax.vmap(
+            lambda k, l, t: jax.random.categorical(k, l / t)
+        )(keys, logits, jnp.maximum(temps, 1e-6)).astype(jnp.int32)
+        nxt = jnp.where(temps > 0.0, sampled, greedy)
         return jnp.where(live, nxt, prev)
+
+    def _split_keys(self, state):
+        """One key split per slot per tick (= per emitted token for
+        temperature slots, both in one-token and speculative ticks —
+        the key discipline speculation must preserve). Greedy slots'
+        splits are dead lanes the masked select never reads."""
+        split = jax.vmap(jax.random.split)(state["rng"])
+        return split[:, 0], split[:, 1]
 
     def _decode(self, params, state):
         cfg = self.pool
@@ -194,13 +234,9 @@ class Engine:
             )
             new_k.append(kp)
             new_v.append(vp)
-        xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
-        logits = (xf @ params["embed/tok"].T)[:, 0]
-        keys = new_rng = state["rng"]
-        if self.temperature > 0.0:
-            split = jax.vmap(jax.random.split)(state["rng"])
-            new_rng, keys = split[:, 0], split[:, 1]
-        nxt = self._sample(logits, keys, live, tokens)
+        logits = lm_head(params, x)[:, 0]
+        new_rng, keys = self._split_keys(state)
+        nxt = self._sample(logits, keys, state["temp"], live, tokens)
         new_state = {
             **state,
             "tokens": nxt,
@@ -261,10 +297,158 @@ class Engine:
             )
             new_k.append(kp)
             new_v.append(vp)
-        xf = _layernorm(x, params["ln_f/scale"], params["ln_f/bias"])
-        logits = (xf[0] @ params["embed/tok"].T)
+        logits = lm_head(params, x)[0]
         last = jnp.take(logits, jnp.maximum(n_valid - 1, 0), axis=0)
         return {**state, "k": tuple(new_k), "v": tuple(new_v)}, last
+
+    def _verify(self, params, state, draft, n_draft):
+        """The speculative tick: score every live slot's current token
+        plus its drafted candidates — (S, K+1) positions — in ONE
+        forward through the paged pool, exactly the chunked-prefill
+        shape discipline batched over slots.
+
+        Sequence per slot: t_0 = the slot's current (last emitted)
+        token at position pos, t_1..t_K = ``draft`` at pos+1..pos+K
+        (``n_draft`` gates how many are real; the rest ride masked to
+        the trash block, the prefill padding discipline). Query j's
+        logits predict position pos+j+1 GIVEN the draft prefix — so
+        greedy acceptance is the longest prefix of the draft matching
+        the model's own argmax continuations (cumprod), plus the bonus
+        token at the first mismatch. By induction every accepted
+        token — and the bonus — is exactly what sequential one-token
+        ticks would have emitted: speculation changes *when* tokens
+        appear, never *which*.
+
+        KV REWIND, by never writing what sequential decode would not
+        have: attention runs against the GATHERED dense views with the
+        chunk's fresh K/V OVERLAID (query j sees the draft prefix's
+        entries without the pool being touched), and the pool itself
+        takes ONE masked scatter after acceptance is known — accepted
+        positions land, rejected/padding/dead positions route to the
+        trash block. Un-advancing a rejected position is therefore a
+        no-op on its pool bytes, and the pool after ANY accept/reject
+        pattern is bitwise what one-token ticks leave (the parity
+        tests pin it) at the same memory traffic as the decode tick
+        (one gather + one scatter per pool array).
+
+        Returns (state', emitted (S, K+1) — -1 beyond each slot's
+        accepted run and on dead slots — and accepted (S,) draft-token
+        counts for the acceptance-rate telemetry)."""
+        cfg, mcfg = self.pool, self.cfg
+        tokens, pos, live = state["tokens"], state["pos"], state["live"]
+        kd = draft.shape[1]
+        q = kd + 1
+        seq = jnp.concatenate([tokens[:, None], draft], axis=1)  # (S, Q)
+        j = jnp.arange(q)[None, :]
+        p = pos[:, None] + j                                     # (S, Q)
+        valid = live[:, None] & (j <= n_draft[:, None])
+        p_safe = jnp.minimum(p, mcfg.max_len - 1)
+        x = params["embed/tok"][seq] + params["embed/pos"][p_safe]
+        row_idx = jnp.minimum(
+            p_safe // cfg.block_len, state["tables"].shape[1] - 1
+        )
+        bid = jnp.take_along_axis(state["tables"], row_idx, axis=1)
+        bid = jnp.where(valid, bid, 0)
+        off = p_safe % cfg.block_len
+        s_idx = jnp.arange(draft.shape[0])[:, None]  # (S, 1)
+        fresh = []
+
+        def overlay(pool_arr, new_shqd):
+            """(S, H, C, D) gathered view with the fresh chunk K/V
+            scattered over each slot's [pos, pos+kd] columns — the
+            pool itself is NOT written here (rejected positions must
+            stay untouched); entries beyond a slot's n_draft are
+            garbage no valid query's causal mask can reach (query j
+            attends positions <= pos + j only)."""
+            dense = self._gather(pool_arr, state["tables"])
+            return dense.at[s_idx, :, p_safe].set(
+                jnp.moveaxis(new_shqd, 1, 2)
+            )
+
+        def mk_attend(i):
+            def attend(qh, kh, vh):
+                if kd == 0:
+                    # zero draft width: rewind is definitionally inert
+                    # (nothing can be rejected), so take the decode
+                    # tick's write-then-gather memory pattern instead
+                    # of double-buffering an overlay view — this shape
+                    # IS serve_bench's isolated-machinery probe, and
+                    # the write targets (bid routes dead lanes to
+                    # trash) equal the post-acceptance routing below
+                    kp = state["k"][i].at[bid, :, off].set(
+                        jnp.moveaxis(kh, 1, 2)
+                    )
+                    vp = state["v"][i].at[bid, :, off].set(
+                        jnp.moveaxis(vh, 1, 2)
+                    )
+                    o = cache_attend(
+                        qh,
+                        self._gather(kp, state["tables"]),
+                        self._gather(vp, state["tables"]),
+                        p,
+                    )
+                    return o, (kp, vp)
+                o = cache_attend(
+                    qh,
+                    overlay(state["k"][i], kh),
+                    overlay(state["v"][i], vh),
+                    p,
+                )
+                return o, (kh, vh)
+            return attend
+
+        for i in range(mcfg.n_layers):
+            x, _, extras = _block_apply(
+                params, f"blk{i}", x, mk_attend(i), mcfg,
+                moe_capacity_factor=float(max(mcfg.moe_experts, 1)),
+            )
+            fresh.append(extras)
+        logits = lm_head(params, x)                              # (S, Q, V)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        new_rng, keys = self._split_keys(state)
+        # position 0 samples through the temperature lane (temperature
+        # slots ride the verify tick with n_draft == 0: their one
+        # emitted token per tick is this sample); positions >= 1 are
+        # greedy-only — temperature slots never accept drafts
+        first = self._sample(logits[:, 0], keys, state["temp"], live, tokens)
+        g = jnp.concatenate([first[:, None], greedy[:, 1:]], axis=1)
+        match = (draft == g[:, :kd]) & (
+            jnp.arange(kd)[None, :] < n_draft[:, None]
+        )
+        acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        emit_mask = live[:, None] & (j <= acc[:, None])
+        emitted = jnp.where(emit_mask, g, jnp.int32(-1))
+        last_tok = jnp.take_along_axis(g, acc[:, None], axis=1)[:, 0]
+        # the rewind-by-construction scatter: ONLY positions sequential
+        # decode would have written (j <= acc, live) land in real
+        # blocks; everything else routes to trash. At kd == 0 the
+        # attend already wrote the pool with that exact routing.
+        if kd == 0:
+            new_k = [kp for kp, _ in fresh]
+            new_v = [vp for _, vp in fresh]
+        else:
+            bid_keep = jnp.where(emit_mask, bid, 0)
+            new_k, new_v = [], []
+            for (kh, vh) in fresh:
+                new_k.append(
+                    state["k"][len(new_k)].at[bid_keep, :, off].set(
+                        jnp.moveaxis(kh, 1, 2)
+                    )
+                )
+                new_v.append(
+                    state["v"][len(new_v)].at[bid_keep, :, off].set(
+                        jnp.moveaxis(vh, 1, 2)
+                    )
+                )
+        new_state = {
+            **state,
+            "tokens": jnp.where(live, last_tok, tokens),
+            "pos": pos + jnp.where(live, acc + 1, 0),
+            "rng": new_rng,
+            "k": tuple(new_k),
+            "v": tuple(new_v),
+        }
+        return new_state, emitted, jnp.where(live, acc, 0)
 
     def _admit_prog(self, state, slot, row):
         return {
@@ -274,20 +458,20 @@ class Engine:
             "live": state["live"].at[slot].set(False),
         }
 
-    def _activate_prog(self, state, slot, last_logits, plen, seed):
+    def _activate_prog(self, state, slot, last_logits, plen, seed, temp):
         rng = jax.random.PRNGKey(seed)
         k0, rng = jax.random.split(rng)
-        if self.temperature <= 0.0:
-            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        else:
-            first = jax.random.categorical(
-                k0, last_logits / self.temperature
-            ).astype(jnp.int32)
+        greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            k0, last_logits / jnp.maximum(temp, 1e-6)
+        ).astype(jnp.int32)
+        first = jnp.where(temp > 0.0, sampled, greedy)
         return {
             **state,
             "tokens": state["tokens"].at[slot].set(first),
             "pos": state["pos"].at[slot].set(plen),
             "live": state["live"].at[slot].set(True),
+            "temp": state["temp"].at[slot].set(temp),
             "rng": state["rng"].at[slot].set(rng),
         }, first
 
@@ -334,13 +518,17 @@ class Engine:
         )
         return last
 
-    def activate(self, slot: int, last_logits, plen: int, seed: int) -> int:
+    def activate(self, slot: int, last_logits, plen: int, seed: int,
+                 temperature: float | None = None) -> int:
         """Sample the first token from the final prefill chunk's logits
         (the same key discipline as generate(): k0 = first split of the
-        request's key) and flip the slot live. -> the first token."""
+        request's key), install the slot's temperature lane, and flip
+        it live. ``temperature`` None = the engine default. -> the
+        first token."""
+        temp = self.temperature if temperature is None else float(temperature)
         self.state, first = self._activate_jit(
             self.state, jnp.int32(slot), last_logits,
-            jnp.int32(plen), jnp.int32(seed),
+            jnp.int32(plen), jnp.int32(seed), jnp.float32(temp),
         )
         return int(first)
 
@@ -349,6 +537,22 @@ class Engine:
         (slots,) int32 device array, -1 on dead slots."""
         self.state, emitted = self._decode_jit(self.params, self.state)
         return emitted
+
+    def verify(self, draft, n_draft):
+        """One speculative tick: every live slot advances by its
+        accepted-prefix length + 1. ``draft`` (slots, K) int32 proposed
+        tokens, ``n_draft`` (slots,) int32 how many are real (0 = the
+        slot rides as a one-token tick; temperature slots always 0).
+        K is fixed per engine (EngineConfig.spec_k sizes the compiled
+        program; any K works but each distinct K is its own compile).
+        -> (emitted (slots, K+1) int32 device array — -1 beyond each
+        accepted run and on dead slots — accepted (slots,) int32 draft
+        tokens accepted)."""
+        self.state, emitted, accepted = self._verify_jit(
+            self.params, self.state,
+            jnp.asarray(draft, jnp.int32), jnp.asarray(n_draft, jnp.int32),
+        )
+        return emitted, accepted
 
     def retire(self, slot: int) -> None:
         """Free the slot's blocks and kill its lane (its pool contents

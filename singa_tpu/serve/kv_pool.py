@@ -19,6 +19,19 @@ padding-position writes at it. Gathers may therefore read it freely —
 query's position to -1e30 before the softmax, so trash contents never
 move an output bit (the parity tests pin this).
 
+The speculative verify tick (serve/engine.py ``Engine._verify``)
+extends the same contract to MULTI-POSITION writes: a slot's chunk of
+k+1 candidate positions maps through its table to (block, offset)
+pairs exactly as single-token decode does, and the post-acceptance
+scatter routes every REJECTED position's write to the trash block —
+the KV rewind. Rejected positions' pool bytes are therefore never
+touched, which is what makes "un-advance the cache" an exact no-op
+rather than a restore. Allocation is untouched by speculation: blocks
+for ``prompt + budget`` are claimed all-or-nothing at admission (and
+freed only at retirement/drain), so an accept/reject pattern can never
+strand or leak a block — the accepted-length lane only gates which
+allocated positions hold real entries.
+
 The allocator is host-side bookkeeping (admission-path work, like the
 reference Server's per-param shard map, src/server/server.cc); the
 pools themselves live in the engine's donated device state.
@@ -76,6 +89,13 @@ class KVPool:
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks a sequence of ``n_tokens`` total positions needs."""
         return -(-max(1, n_tokens) // self.block_len)
+
+    def block_offset(self, position: int) -> tuple[int, int]:
+        """Absolute sequence position -> (table row, in-block offset) —
+        the host-side mirror of the device-side index math every write
+        path (decode, prefill, the speculative verify's multi-position
+        scatter) runs; tests pin the two against each other."""
+        return position // self.block_len, position % self.block_len
 
 
 class BlockAllocator:

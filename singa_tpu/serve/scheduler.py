@@ -17,6 +17,16 @@ scheduler answers every client's generation request from one engine:
     single fixed-shape decode program; EOS or an exhausted budget
     retires the slot (blocks freed, available to the next admit — the
     continuous part of continuous batching);
+  - with speculation on (``serving { speculate { k } }``), each tick
+    instead drafts up to k tokens per live greedy slot (model-free
+    n-gram lookup over the request's own prompt+output,
+    serve/speculate.py), runs the engine's fixed-shape VERIFY program
+    once, and fans every accepted token out to its request — EOS or
+    budget hit INSIDE an accepted run retires at exactly the token
+    sequential decode would have stopped at (the tail of the run is
+    discarded, never delivered). Temperature slots ride the same tick
+    with zero drafts. Token streams are identical to one-token ticks
+    by construction; only tick count changes;
   - a SIGTERM'd serving host drains via the resilience plane: the
     serve loop observes ``PreemptionHandler.requested`` at a tick
     boundary, hands every in-flight sequence back (recorded, with its
@@ -40,6 +50,7 @@ import numpy as np
 
 from .engine import Engine
 from .kv_pool import PoolExhausted
+from .speculate import make_drafter
 
 
 @dataclasses.dataclass
@@ -74,14 +85,30 @@ class Scheduler:
     """Continuous-batching loop over one Engine."""
 
     def __init__(self, engine: Engine, *, recorder=None, preemption=None,
-                 log=lambda s: None):
+                 log=lambda s: None, drafter=None):
         self.engine = engine
         self.recorder = recorder
         self.preemption = preemption
         self.log = log
+        #: speculative decode: k > 0 routes every decode tick through
+        #: the engine's verify program; the drafter proposes (override
+        #: for tests/probes — e.g. speculate.NullDrafter forces zero
+        #: acceptance while keeping the whole verify path hot)
+        self.spec_k = engine.serving.spec_k
+        if drafter is not None:
+            self.drafter = drafter
+        else:
+            self.drafter = (
+                make_drafter(engine.serving.spec_drafter)
+                if self.spec_k > 0 else None
+            )
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self._queue: collections.deque[Request] = collections.deque()
         self._slot_req: dict[int, Request] = {}
         self.ticks = 0
+        #: ticks that ran a decode/verify program (>= 1 slot decoding)
+        self.decode_ticks = 0
         self.tokens_emitted = 0
         self.backpressure_ticks = 0
         #: sum over ticks of live (decoding) slots — occupancy reporting
@@ -93,15 +120,27 @@ class Scheduler:
         self.full_tick_tokens = 0
         self.finished: list[Request] = []
 
+    def reset_counters(self) -> None:
+        """Zero every accumulated statistic (ticks, token/draft counts,
+        occupancy, backpressure, finished list) — the benchmark
+        harnesses call this after a compile-warm request so warmup
+        never contaminates measured numbers. Live/queued requests are
+        untouched."""
+        self.finished.clear()
+        self.ticks = self.decode_ticks = 0
+        self.tokens_emitted = 0
+        self.spec_drafted = self.spec_accepted = 0
+        self._live_ticks = 0
+        self.backpressure_ticks = 0
+        self.full_tick_s, self.full_tick_tokens = 0.0, 0
+
     # -- client side ----------------------------------------------------
 
     def submit(self, req: Request) -> None:
-        if req.temperature != self.engine.temperature:
-            raise ValueError(
-                f"request {req.rid}: temperature {req.temperature} != "
-                f"engine temperature {self.engine.temperature} (one "
-                "compiled decode program serves every slot)"
-            )
+        # any temperature is admissible: the engine's per-slot
+        # temperature lane means one compiled program serves every mix
+        # of sampling configs (the old same-temperature rejection is
+        # gone with it)
         total = len(req.prompt) + req.max_new_tokens
         if total > self.engine.cfg.max_len:
             raise ValueError(
@@ -190,7 +229,8 @@ class Scheduler:
             )
             if req._prefilled >= len(req.prompt):
                 first = self.engine.activate(
-                    slot, last, len(req.prompt), req.seed
+                    slot, last, len(req.prompt), req.seed,
+                    temperature=req.temperature,
                 )
                 req.tokens.append(first)
                 req.status = "decoding"
@@ -223,10 +263,28 @@ class Scheduler:
                 track="requests", steps=len(req.tokens),
             )
 
+    def _draft_for(self, req: Request) -> list[int]:
+        """Draft tokens for one decoding request: greedy slots only
+        (speculation is greedy-only per slot — a temperature slot's
+        sampled continuation is not the drafter's to predict), clamped
+        so the accepted run can never overshoot the budget (at most
+        ``budget_remaining`` tokens emit per tick, the last being the
+        bonus) nor write past the request's allocated blocks."""
+        if req.temperature > 0.0:
+            return []
+        budget_rem = req.max_new_tokens - len(req.tokens)
+        n = min(self.spec_k, budget_rem - 1)
+        if n <= 0:
+            return []
+        ctx = list(req.prompt) + req.tokens
+        return list(self.drafter.draft(ctx, n))[:n]
+
     def tick(self) -> int:
         """One scheduling round: retire happens inline as tokens land,
         admit fills freed slots, prefill advances one chunk each, then
-        every live slot decodes one token. -> tokens emitted."""
+        every live slot decodes — one token through the decode program,
+        or up to spec_k + 1 through the verify program when speculation
+        is on. -> tokens emitted."""
         self._admit_some()
         self._prefill_some()
         decoding = {
@@ -234,15 +292,41 @@ class Scheduler:
         }
         emitted_n = 0
         if decoding:
+            accepted_n = 0
             t0w, t0 = time.time(), time.perf_counter()
-            emitted = np.asarray(self.engine.decode())
+            if self.spec_k > 0:
+                slots = self.engine.serving.slots
+                drafts = np.zeros((slots, self.spec_k), np.int32)
+                nd = np.zeros((slots,), np.int32)
+                for slot, req in decoding.items():
+                    d = self._draft_for(req)
+                    drafts[slot, :len(d)] = d
+                    nd[slot] = len(d)
+                drafted_n = int(nd.sum())
+                self.spec_drafted += drafted_n
+                self._event(
+                    "spec_draft", drafted=drafted_n, live=len(decoding),
+                )
+                emitted_dev, accepted_dev = self.engine.verify(drafts, nd)
+                emitted = np.asarray(emitted_dev)
+                accepted_n = int(np.asarray(accepted_dev).sum())
+                self.spec_accepted += accepted_n
+            else:
+                emitted = np.asarray(self.engine.decode())[:, None]
             dur = time.perf_counter() - t0
             for slot, req in sorted(decoding.items()):
-                tok = int(emitted[slot])
-                req.tokens.append(tok)
-                emitted_n += 1
-                self._check_done(slot, req, tok)
+                # fan the slot's accepted run out token by token: EOS
+                # or budget INSIDE the run stops exactly where
+                # sequential decode would have — the tail is discarded
+                for tok in emitted[slot]:
+                    if tok < 0:
+                        break
+                    req.tokens.append(int(tok))
+                    emitted_n += 1
+                    if self._check_done(slot, req, int(tok)):
+                        break
             self._live_ticks += len(decoding)
+            self.decode_ticks += 1
             self.tokens_emitted += emitted_n
             if len(decoding) == self.engine.serving.slots:
                 self.full_tick_s += dur
@@ -251,6 +335,11 @@ class Scheduler:
                 self.recorder.record_span(
                     "decode_tick", t0w, dur,
                     track="serving", steps=emitted_n,
+                )
+            if self.spec_k > 0:
+                self._event(
+                    "spec_accept", accepted=accepted_n, emitted=emitted_n,
+                    drafted=drafted_n,
                 )
             self._event(
                 "decode_tick", live=len(decoding), emitted=emitted_n,
@@ -314,7 +403,7 @@ class Scheduler:
 
     def occupancy(self) -> dict:
         ticks = max(1, self.ticks)
-        return {
+        out = {
             "slot_occupancy": round(
                 self._live_ticks / (ticks * self.engine.serving.slots), 4
             ),
@@ -322,3 +411,15 @@ class Scheduler:
             "kv_blocks_total": self.engine.pool.n_blocks - 1,
             "backpressure_ticks": self.backpressure_ticks,
         }
+        if self.spec_k > 0:
+            # acceptance rate = accepted draft tokens / drafted; the
+            # emitted bonus tokens ride free either way
+            out["spec_drafted"] = self.spec_drafted
+            out["spec_accepted"] = self.spec_accepted
+            out["acceptance_rate"] = round(
+                self.spec_accepted / max(1, self.spec_drafted), 4
+            )
+            out["tokens_per_tick"] = round(
+                self.tokens_emitted / max(1, self.decode_ticks), 4
+            )
+        return out

@@ -246,6 +246,19 @@ def summarize(records: list[dict]) -> dict:
                     skew, max(ts_by_rank.values()) - min(ts_by_rank.values())
                 )
 
+    # speculative decode: per-tick spec_draft/spec_accept events carry
+    # drafted/accepted token counts (serve/scheduler.py)
+    spec_drafted = sum(
+        int(r["data"].get("drafted", 0))
+        for r in life
+        if r.get("kind") == "spec_draft" and isinstance(r.get("data"), dict)
+    )
+    spec_accepted = sum(
+        int(r["data"].get("accepted", 0))
+        for r in life
+        if r.get("kind") == "spec_accept" and isinstance(r.get("data"), dict)
+    )
+
     faults = [
         r["data"].get("fault")
         for r in life
@@ -325,6 +338,19 @@ def summarize(records: list[dict]) -> dict:
             "tokens_per_s": round(tick_tokens / tick_s, 1)
             if tick_s > 0
             else 0.0,
+            # speculative decode's amortization factor: emitted tokens
+            # per verify/decode tick (1.0 * live slots without
+            # speculation; higher = accepted drafts riding one weight
+            # stream) and the drafter's acceptance rate (None = no
+            # speculation events in this log)
+            "tokens_per_tick": round(tick_tokens / ticks, 2)
+            if ticks
+            else None,
+            "acceptance_rate": round(spec_accepted / spec_drafted, 4)
+            if spec_drafted
+            else None,
+            "spec_drafted": spec_drafted,
+            "spec_accepted": spec_accepted,
             "admitted": counts.get("request_admit", 0),
             "retired": counts.get("retire", 0),
             "evicted": counts.get("evict", 0),
