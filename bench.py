@@ -539,6 +539,53 @@ def bench_lm_d128_spec():
     }
 
 
+def bench_lm_d128_prefix():
+    """Prefix caching on the serving shape: the shared_prefix workload
+    (one long common system-prompt prefix, short unique tails) with
+    the content-addressed refcounted block cache warm vs the same
+    engine cold (cache disabled). `tokens_per_s` (warm) is the row
+    value; `prefix_speedup` the warm/cold end-to-end ratio;
+    `hit_rate`, `blocks_shared`, and `prefill_chunks_saved` are the
+    deterministic numbers a regression in matching, sharing, or the
+    admission seeding would move (`prefill_chunk_ratio` is the
+    host-independent or-gate arm CI enforces); `cow_copies` pins that
+    the whole-prompt-hit copy-on-write path actually ran. Identity
+    (token_mismatches == 0) is the hard bar — a hit may only skip
+    prefill work, never move a token."""
+    import io
+    from contextlib import redirect_stdout
+
+    from singa_tpu.tools import serve_bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        serve_bench.main([
+            "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+            "--requests", "12", "--max_new", "16", "--no_gate",
+            "--workload", "shared_prefix", "--prompt_len", "48",
+            "--block_len", "8", "--prefill_chunk", "8",
+        ])
+    r = json.loads(buf.getvalue().strip().splitlines()[-1])
+    return {
+        "name": "lm_d128_prefix",
+        "value": r["tokens_per_s"],
+        "unit": "tokens/sec",
+        "tokens_per_s": r["tokens_per_s"],
+        "cold_tokens_per_s": r.get("cold_tokens_per_s"),
+        "prefix_speedup": r.get("prefix_speedup"),
+        "hit_rate": r.get("prefix_hit_rate"),
+        "blocks_shared": r.get("blocks_shared"),
+        "prefill_chunks_saved": r.get("prefill_chunks_saved"),
+        "prefill_chunk_ratio": r.get("prefill_chunk_ratio"),
+        "cow_copies": r.get("cow_copies"),
+        "lru_reclaims": r.get("lru_reclaims"),
+        "p50_ms": r["p50_ms"],
+        "p99_ms": r["p99_ms"],
+        "token_mismatches": r.get("token_mismatches"),
+        "method": "serve_bench shared_prefix workload (request wall clock)",
+    }
+
+
 BENCHES = (
     ("mnist_mlp", bench_mnist_mlp),
     ("cifar_alexnet", bench_cifar_alexnet),
@@ -551,6 +598,7 @@ BENCHES = (
     ("lm_d128_q8", bench_lm_d128_q8),
     ("lm_d128_serve", bench_lm_d128_serve),
     ("lm_d128_spec", bench_lm_d128_spec),
+    ("lm_d128_prefix", bench_lm_d128_prefix),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),

@@ -718,6 +718,30 @@ class SpeculateConfig(Message):
     }
 
 
+class PrefixCacheConfig(Message):
+    """singa-tpu extension: prefix caching for the paged KV pool
+    (serve/kv_pool.py). ``enabled`` turns the block allocator into a
+    content-addressed, refcounted cache: FULL prompt-prefilled blocks
+    are hashed by (prefix-so-far, block token ids), admissions share
+    the incoming prompt's longest cached block-prefix instead of
+    re-prefilling it (copy-on-write where a write into a shared block
+    is unavoidable), and token streams plus the paged cache stay
+    BITWISE identical to cache-disabled admission. ``lru`` keeps
+    refcount-0 cached blocks on an LRU list — reclaimed lazily only
+    when an allocation would otherwise exhaust the pool — so hits
+    survive the cached sequence's retirement; false shares only among
+    concurrently-live sequences."""
+
+    FIELDS = {
+        # content-addressed block sharing at admission (default off:
+        # the PR 9 free-list allocator, no hashing, no refcount > 1)
+        "enabled": Field("bool", False),
+        # park refcount-0 cached blocks on an LRU list instead of
+        # freeing eagerly (reclaimed lazily at pool exhaustion)
+        "lru": Field("bool", True),
+    }
+
+
 class ServingConfig(Message):
     """singa-tpu extension: the serving tier (singa_tpu/serve/) — the
     capability analog of the reference's Server tier (one process
@@ -744,6 +768,9 @@ class ServingConfig(Message):
         "max_prefill_chunk": Field("int", 64),
         # speculative multi-token decode (absent = one-token ticks)
         "speculate": Field("message", message=SpeculateConfig),
+        # refcounted copy-on-write block sharing at admission (absent =
+        # the plain free-list allocator, every prompt fully prefilled)
+        "prefix_cache": Field("message", message=PrefixCacheConfig),
     }
 
 

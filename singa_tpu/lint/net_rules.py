@@ -66,6 +66,11 @@ CMM001 = rule(
     ERROR,
     "active grad_comm block combined with the replica (async PS) engine",
 )
+SRV001 = rule(
+    "SRV001",
+    ERROR,
+    "prefix_cache enabled but kv_blocks cannot hold one max-length prompt",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -369,6 +374,56 @@ def engine_rules(
 
 
 # ---------------------------------------------------------------------------
+# serving rules (model conf alone)
+# ---------------------------------------------------------------------------
+
+
+def serving_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
+    """SRV001 — static admission feasibility for a prefix-caching
+    serving tier (the shardlint direction: predict the capacity cliff
+    before any pod time is burned). serve/kv_pool.KVPool.for_model
+    raises at engine construction when ``kv_blocks`` cannot hold even
+    ONE full-length sequence plus the trash block; with
+    ``prefix_cache`` enabled that failure is doubly wasteful — the
+    operator sized the pool for cache wins it can never admit. The
+    model's positional window comes from the kEmbedding layer's
+    declared ``max_len``; a window left to the data layer's sequence
+    length (max_len 0) is not statically decidable and is skipped."""
+    srv = getattr(model_cfg, "serving", None)
+    if srv is None or srv.prefix_cache is None or not srv.prefix_cache.enabled:
+        return
+    if srv.kv_blocks <= 0:
+        return  # dense-equivalent sizing always fits one sequence
+    net_cfg = model_cfg.neuralnet
+    if net_cfg is None:
+        return
+    window = max(
+        (
+            l.embedding_param.max_len
+            for l in net_cfg.layer
+            if l.embedding_param is not None and l.embedding_param.max_len
+        ),
+        default=0,
+    )
+    if not window:
+        return
+    block_len = max(1, srv.kv_block_len)
+    need = -(-window // block_len) + 1  # one full sequence + trash block
+    if srv.kv_blocks < need:
+        col.emit(
+            SRV001,
+            path,
+            f"serving.prefix_cache enabled with kv_blocks "
+            f"{srv.kv_blocks} < {need} needed to admit one max-length "
+            f"prompt ({window} positions / kv_block_len {block_len} + "
+            "the reserved trash block): every admission would raise "
+            "before the cache could ever hit",
+            fix_hint=f"set kv_blocks >= {need} (or 0 for "
+            "dense-equivalent sizing)",
+        )
+
+
+# ---------------------------------------------------------------------------
 # sharding rules (model conf x cluster axis widths)
 # ---------------------------------------------------------------------------
 
@@ -491,6 +546,7 @@ def lint_model_text(
             col.emit(CFG000, path, str(e))
         return None
     graph_rules(model_cfg, path, col)
+    serving_rules(model_cfg, path, col)
     if widths:
         sharding_rules_static(model_cfg, widths, path, col)
     return model_cfg

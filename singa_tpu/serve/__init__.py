@@ -13,7 +13,16 @@ Three layers, each importable alone:
                    + per-sequence block tables, so thousands of
                    concurrent streams share device memory instead of
                    each reserving max_len (vLLM's PagedAttention idea,
-                   sized for this repo's engines).
+                   sized for this repo's engines). With
+                   ``serving { prefix_cache { enabled } }`` the
+                   allocator is a content-addressed, refcounted block
+                   cache: full prompt-prefilled blocks are hashed by
+                   (prefix-so-far, block tokens), admissions share the
+                   longest cached block-prefix instead of re-prefilling
+                   it (copy-on-write where a shared block must be
+                   written, LRU-parked refcount-0 blocks reclaimed
+                   lazily), and streams + the paged cache stay bitwise
+                   identical to cold admission.
   ``engine``       the compute plane: ONE donated, jitted fixed-shape
                    decode step over a slot-batched state, plus
                    fixed-shape chunked prefill — admitting/retiring
@@ -44,7 +53,7 @@ nets (tools/generate.py); ``tools/serve_bench.py`` is the load harness
 and CI gate.
 """
 
-from .engine import Engine, EngineConfig  # noqa: F401
-from .kv_pool import BlockAllocator, KVPool  # noqa: F401
+from .engine import Admission, Engine, EngineConfig  # noqa: F401
+from .kv_pool import BlockAllocator, KVPool, PrefixCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .speculate import NGramDrafter, NullDrafter, make_drafter  # noqa: F401

@@ -63,7 +63,7 @@ from ..models.transformer import (
     cache_attend,
     lm_head,
 )
-from .kv_pool import BlockAllocator, KVPool
+from .kv_pool import BlockAllocator, KVPool, PoolExhausted
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +79,14 @@ class EngineConfig:
     spec_k: int = 0
     #: drafter name (serve/speculate.py DRAFTERS)
     spec_drafter: str = "ngram"
+    #: ``serving { prefix_cache { enabled } }``: content-addressed,
+    #: refcounted block sharing — admissions reuse cached full-block
+    #: prompt prefixes instead of re-prefilling them
+    prefix_cache: bool = False
+    #: keep refcount-0 cached blocks on an LRU list (reclaimed lazily)
+    #: instead of freeing them at retirement; False = share only among
+    #: concurrently-live sequences
+    prefix_lru: bool = True
 
     @classmethod
     def from_conf(cls, serving) -> "EngineConfig":
@@ -86,6 +94,7 @@ class EngineConfig:
         if serving is None:
             return cls()
         spec = serving.speculate
+        pc = serving.prefix_cache
         return cls(
             slots=serving.slots,
             kv_block_len=serving.kv_block_len,
@@ -93,7 +102,24 @@ class EngineConfig:
             max_prefill_chunk=serving.max_prefill_chunk,
             spec_k=spec.k if spec is not None else 0,
             spec_drafter=spec.drafter if spec is not None else "ngram",
+            prefix_cache=pc.enabled if pc is not None else False,
+            prefix_lru=pc.lru if pc is not None else True,
         )
+
+
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """What admit() did for one request: the sequence's full block list
+    (shared prefix blocks first), how many prompt tokens the prefix
+    cache covered, where prefill must start (== ``cached_tokens``
+    except on a WHOLE-prompt hit, where the last token re-runs through
+    a COW'd block to re-derive the activation logits), and whether a
+    copy-on-write happened."""
+
+    blocks: list
+    cached_tokens: int = 0
+    prefill_from: int = 0
+    cow_copied: bool = False
 
 
 class Engine:
@@ -115,7 +141,11 @@ class Engine:
             cfg.max_len, self.serving.kv_block_len,
             self.serving.kv_blocks, self.serving.slots,
         )
-        self.allocator = BlockAllocator(self.pool)
+        self.allocator = BlockAllocator(
+            self.pool,
+            prefix_cache=self.serving.prefix_cache,
+            lru=self.serving.prefix_lru,
+        )
         self.params = params
         s, mb = self.serving.slots, self.pool.max_blocks_per_seq
         shape = (
@@ -149,6 +179,9 @@ class Engine:
         }
         #: blocks owned per slot, freed at retire
         self._slot_blocks: dict[int, list[int]] = {}
+        #: the admission-time digest chain per slot (register_prefix
+        #: reuses it — one hashing pass per request, not two)
+        self._slot_chain: dict[int, list[bytes]] = {}
         self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
         self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,))
         self._verify_jit = jax.jit(self._verify, donate_argnums=(1,))
@@ -160,6 +193,9 @@ class Engine:
             self._activate_prog, donate_argnums=(0,)
         )
         self._retire_jit = jax.jit(self._retire_prog, donate_argnums=(0,))
+        # copy-on-write: one fixed-shape block copy (src/dst are traced
+        # scalars, so every COW reuses ONE compiled program)
+        self._cow_jit = jax.jit(self._cow_prog, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -484,23 +520,114 @@ class Engine:
             ),
         }
 
+    def _cow_prog(self, state, src, dst):
+        """Copy block ``src``'s K/V to block ``dst`` in every layer —
+        the copy-on-write a whole-prompt prefix hit needs before its
+        last-token prefill chunk may write (the source stays shared,
+        only this sequence's table points at the copy)."""
+        return {
+            **state,
+            "k": tuple(k.at[dst].set(k[src]) for k in state["k"]),
+            "v": tuple(v.at[dst].set(v[src]) for v in state["v"]),
+        }
+
     # ------------------------------------------------------------------
     # admission-path API (host-driven, one fused dispatch each, never on
     # the tick path of OTHER slots' decode)
     # ------------------------------------------------------------------
 
-    def admit(self, slot: int, n_total_tokens: int) -> list[int]:
+    def admit(self, slot: int, n_total_tokens: int,
+              prompt=None) -> Admission:
         """Allocate ``blocks_for(n_total_tokens)`` blocks to ``slot`` and
         install its block table (raises PoolExhausted untouched —
-        admission backpressure). The slot stays dead until activate()."""
-        blocks = self.allocator.alloc(self.pool.blocks_for(n_total_tokens))
+        admission backpressure). The slot stays dead until activate().
+
+        With the prefix cache on and a ``prompt`` given, the prompt's
+        longest cached block-prefix is SHARED instead of allocated:
+        matched blocks are retained (refcount bumped, LRU blocks
+        revived) and only the uncached tail draws fresh blocks — the
+        all-or-nothing contract still holds: hit-plus-tail feasibility
+        is checked BEFORE any state is touched, so a backpressured
+        admission raises PoolExhausted as a true no-op (free list,
+        LRU order, index, and reclaim telemetry untouched — the
+        request retries next tick). A hit covering the WHOLE
+        prompt still needs the last prompt position's logits to sample
+        the first token, so the final matched block is COPY-ON-WRITTEN
+        (one fixed-shape compiled copy) and ``prefill_from`` points at
+        the last prompt token — one 1-token chunk re-derives the
+        activation logits, writing bitwise the bytes the shared source
+        already holds, into the private copy only."""
+        needed = self.pool.blocks_for(n_total_tokens)
+        alloc = self.allocator
+        hit: list[int] = []
+        chain: list[bytes] = []
+        if alloc.cache is not None and prompt is not None:
+            # ONE digest pass per admission: the same chain serves the
+            # match here and register_prefix() after prefill completes
+            chain = alloc.cache.chain(prompt)
+            hit = alloc.cache.match_chain(chain)
+        cached = len(hit) * self.pool.block_len
+        cow = bool(hit) and cached >= len(prompt)
+        fresh_n = needed - len(hit) + (1 if cow else 0)
+        if fresh_n > alloc.headroom_excluding(hit):
+            raise PoolExhausted(
+                f"need {fresh_n} fresh blocks beyond a {len(hit)}-block "
+                f"prefix hit, {alloc.headroom_excluding(hit)} allocatable"
+            )
+        if hit:
+            alloc.retain(hit)
+        fresh = alloc.alloc(fresh_n)
+        if cow:
+            # the whole prompt is cached: COW the last matched block so
+            # the re-derivation chunk can write without touching the
+            # shared source, then drop our extra reference to it
+            src, dst = hit[-1], fresh[0]
+            blocks = hit[:-1] + [dst] + fresh[1:]
+            self.state = self._cow_jit(
+                self.state, jnp.int32(src), jnp.int32(dst)
+            )
+            alloc.release([src])
+        else:
+            blocks = hit + fresh
         row = np.zeros((self.pool.max_blocks_per_seq,), np.int32)
         row[: len(blocks)] = blocks
         self.state = self._admit_jit(
             self.state, jnp.int32(slot), jnp.asarray(row)
         )
         self._slot_blocks[slot] = blocks
-        return blocks
+        self._slot_chain[slot] = chain
+        return Admission(
+            blocks=blocks,
+            cached_tokens=cached,
+            prefill_from=min(cached, max(len(prompt), 1) - 1)
+            if prompt is not None else 0,
+            cow_copied=cow,
+        )
+
+    def register_prefix(self, slot: int, prompt) -> int:
+        """Index ``slot``'s fully-prompt-covered blocks by their chained
+        content digests (called once the slot's prompt is completely
+        prefilled — every registered position is prefill-written, so a
+        later hit's bytes are bitwise a cold prefill's). Digests already
+        present are skipped (shared blocks; concurrent identical
+        prompts keep the first writer); new entries link to their
+        parent digest, the chain structure eviction cascades through.
+        -> newly registered blocks."""
+        cache = self.allocator.cache
+        if cache is None:
+            return 0
+        blocks = self._slot_blocks.get(slot)
+        if not blocks:
+            return 0
+        chain = self._slot_chain.get(slot) or cache.chain(prompt)
+        new = 0
+        for i, digest in enumerate(chain):
+            if not cache.has(digest):
+                new += cache.register(
+                    digest, blocks[i],
+                    parent=chain[i - 1] if i else None,
+                )
+        return new
 
     def prefill_chunk(self, slot: int, tokens: np.ndarray, pos0: int):
         """Run one prompt chunk (<= max_prefill_chunk tokens) for
@@ -555,9 +682,13 @@ class Engine:
         return emitted, accepted
 
     def retire(self, slot: int) -> None:
-        """Free the slot's blocks and kill its lane (its pool contents
-        become reusable garbage, masked wherever gathered)."""
+        """Release the slot's blocks (refcount decrement: shared prefix
+        blocks stay live for their other owners, registered refcount-0
+        blocks park on the LRU list, the rest return to the free list
+        as reusable garbage, masked wherever gathered) and kill its
+        lane."""
         self.state = self._retire_jit(self.state, jnp.int32(slot))
+        self._slot_chain.pop(slot, None)
         blocks = self._slot_blocks.pop(slot, None)
         if blocks:
-            self.allocator.free(blocks)
+            self.allocator.release(blocks)

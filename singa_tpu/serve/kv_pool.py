@@ -1,4 +1,4 @@
-"""Paged KV cache: fixed-size block pools + per-sequence block tables.
+"""Paged KV cache: fixed-size block pools, block tables, prefix cache.
 
 A dense serving cache reserves ``slots * max_len`` K/V positions per
 layer no matter how long each stream actually is; at thousands of
@@ -26,11 +26,36 @@ pairs exactly as single-token decode does, and the post-acceptance
 scatter routes every REJECTED position's write to the trash block —
 the KV rewind. Rejected positions' pool bytes are therefore never
 touched, which is what makes "un-advance the cache" an exact no-op
-rather than a restore. Allocation is untouched by speculation: blocks
-for ``prompt + budget`` are claimed all-or-nothing at admission (and
-freed only at retirement/drain), so an accept/reject pattern can never
-strand or leak a block — the accepted-length lane only gates which
-allocated positions hold real entries.
+rather than a restore.
+
+PREFIX CACHING turns the allocator into a content-addressed,
+refcounted block cache (``serving { prefix_cache { enabled } }``).
+Every block carries a refcount. A FULL block — all ``block_len``
+positions prefill-written from prompt tokens — is hashed by
+``(hash-of-prefix-so-far, block token ids)``, so a block's identity
+includes its ENTIRE left context and (via the chain length) its
+absolute positions: two requests sharing a system prompt map to the
+same digests block for block. At admission the scheduler matches the
+incoming prompt's longest cached block-prefix and points the new
+sequence's table at the SHARED blocks (refcount bumped); prefill drops
+to the uncached tail. Sharing is sound because a fully-prompt-covered
+block is immutable — decode and verify only ever write at positions
+``>= prompt_len``, which live in later, privately-owned blocks — and
+because prefill chunking is bitwise split-invariant (PR 9's pinned
+property), a warm sequence's pool bytes are bit-for-bit what its own
+cold prefill would have written. The one place a sequence must write
+into a shared block — re-deriving the last-token logits when the hit
+covers the WHOLE prompt — is COPY-ON-WRITE: the engine copies the
+block to a fresh one and repoints only its own table, so sharing stays
+invisible to the fixed-shape decode/prefill/verify programs (they
+just read through block tables; admit/retire/COW never recompiles).
+
+Retirement decrements refcounts. A refcount-0 block that is REGISTERED
+in the prefix index moves to an LRU list instead of the free list —
+reclaimed lazily, oldest first, only when an allocation would
+otherwise raise PoolExhausted — so backpressure semantics are
+unchanged while a warm pool keeps serving hits across request
+lifetimes (multi-turn traffic hits its own history).
 
 The allocator is host-side bookkeeping (admission-path work, like the
 reference Server's per-param shard map, src/server/server.cc); the
@@ -39,12 +64,17 @@ pools themselves live in the engine's donated device state.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
+
+import numpy as np
 
 
 class PoolExhausted(Exception):
-    """No free blocks for an allocation — the scheduler's admission
-    backpressure signal (queued requests wait for a retirement)."""
+    """No free (or LRU-reclaimable) blocks for an allocation — the
+    scheduler's admission backpressure signal (queued requests wait
+    for a retirement)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,45 +128,304 @@ class KVPool:
         return position // self.block_len, position % self.block_len
 
 
-class BlockAllocator:
-    """Free-list allocator over a pool's block ids (block 0 reserved)."""
+class PrefixCache:
+    """Content-addressed index over FULL, prompt-prefilled blocks.
 
-    def __init__(self, pool: KVPool):
+    A block's identity is the chained digest
+    ``d_i = H(d_{i-1}, tokens[i*BL : (i+1)*BL])`` (``d_{-1}`` empty):
+    the hash covers the block's own token ids AND, through the chain,
+    every token to its left — so two blocks are interchangeable iff
+    their whole left context matches, which (with prefill's bitwise
+    split-invariance) makes their pool bytes interchangeable too. Only
+    blocks every position of which was prefill-written from PROMPT
+    tokens are registered: decode/verify-written entries ride
+    different compiled shapes (the PR 9 cross-shape caveat), so caching
+    them would trade the bitwise-identical-to-cold guarantee for a
+    token-level one. The index maps digest -> block id; membership is
+    what the allocator's release path consults to route a refcount-0
+    block to the LRU list instead of the free list."""
+
+    def __init__(self, block_len: int):
+        self.block_len = block_len
+        self._by_digest: dict[bytes, int] = {}
+        self._digest_of: dict[int, bytes] = {}
+        #: digest -> parent digest (None for a chain head) and the
+        #: reverse — the chain linkage eviction needs: a child is only
+        #: MATCHABLE through its parent's digest, so dropping a parent
+        #: must cascade or descendants sit indexed-but-unreachable
+        self._parent: dict[bytes, bytes | None] = {}
+        self._children: dict[bytes, set[bytes]] = {}
+
+    def __len__(self) -> int:
+        return len(self._by_digest)
+
+    @staticmethod
+    def _digest(prev: bytes, token_bytes: bytes) -> bytes:
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(token_bytes)
+        return h.digest()
+
+    def chain(self, tokens) -> list[bytes]:
+        """Digests of every FULL block of ``tokens``, left to right.
+        One vectorized int32 serialization for the whole prompt — this
+        runs on the admission path for every request."""
+        buf = np.ascontiguousarray(tokens, dtype="<i4").tobytes()
+        out, prev, width = [], b"", 4 * self.block_len
+        for i in range(len(tokens) // self.block_len):
+            prev = self._digest(prev, buf[i * width:(i + 1) * width])
+            out.append(prev)
+        return out
+
+    def match_chain(self, chain: list[bytes]) -> list[int]:
+        """Block ids of the longest cached prefix of a digest chain (a
+        missing link stops the walk — a block is only reusable under
+        the exact left context it was written in)."""
+        out: list[int] = []
+        for d in chain:
+            b = self._by_digest.get(d)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def match(self, tokens) -> list[int]:
+        """Block ids of the longest cached block-prefix of ``tokens``
+        (full blocks only)."""
+        return self.match_chain(self.chain(tokens))
+
+    def has(self, digest: bytes) -> bool:
+        return digest in self._by_digest
+
+    def is_cached(self, block: int) -> bool:
+        return block in self._digest_of
+
+    def register(self, digest: bytes, block: int,
+                 parent: bytes | None = None) -> bool:
+        """Bind ``digest`` -> ``block`` (``parent`` = the previous
+        block's digest in the chain, None for a head). First writer
+        wins: a digest already present (two identical prompts prefilled
+        concurrently) keeps the existing block and the newcomer stays
+        private."""
+        if digest in self._by_digest or block in self._digest_of:
+            return False
+        self._by_digest[digest] = block
+        self._digest_of[block] = digest
+        self._parent[digest] = parent
+        if parent is not None:
+            self._children.setdefault(parent, set()).add(digest)
+        return True
+
+    def forget(self, block: int) -> list[int]:
+        """Drop a block's index entry AND its descendant subtree — a
+        descendant's digest is only reachable through this block's, so
+        leaving it indexed would strand it unmatchable forever while
+        still counting as cached. -> every block whose entry was
+        removed (the allocator returns the LRU-parked ones to the free
+        list); empty for an unregistered block."""
+        d = self._digest_of.get(block)
+        if d is None:
+            return []
+        removed: list[int] = []
+        stack = [d]
+        while stack:
+            dig = stack.pop()
+            b = self._by_digest.pop(dig, None)
+            if b is None:
+                continue
+            del self._digest_of[b]
+            removed.append(b)
+            parent = self._parent.pop(dig, None)
+            if parent is not None and parent in self._children:
+                self._children[parent].discard(dig)
+                if not self._children[parent]:
+                    del self._children[parent]
+            stack.extend(self._children.pop(dig, ()))
+        return removed
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over a pool's block ids (block 0
+    reserved). With ``prefix_cache`` on it doubles as the block cache's
+    lifetime manager: ``retain`` bumps shared blocks at a prefix hit
+    (reviving LRU blocks), ``release`` decrements at retirement and
+    parks refcount-0 REGISTERED blocks on the LRU list, and ``alloc``
+    reclaims from the LRU only when the free list alone cannot satisfy
+    it (lazy eviction — a warm pool keeps serving hits). ``free`` is
+    the strict exclusive-owner API: it refuses already-free AND shared
+    blocks loudly, all-or-nothing, so a double release can never
+    corrupt the free list (the latent pre-refcount hazard)."""
+
+    def __init__(self, pool: KVPool, *, prefix_cache: bool = False,
+                 lru: bool = True):
         self.pool = pool
         self._free = list(range(pool.n_blocks - 1, 0, -1))  # pop() -> 1,2,..
-        self._owned: set[int] = set()
+        self._ref: dict[int, int] = {}
+        #: refcount-0 registered blocks, oldest-released first
+        self._lru: collections.OrderedDict[int, None] = (
+            collections.OrderedDict()
+        )
+        self.cache: PrefixCache | None = (
+            PrefixCache(pool.block_len) if prefix_cache else None
+        )
+        self.lru_enabled = lru
+        #: optional lifecycle sink: callable(kind, **payload) — the
+        #: scheduler points this at its recorder event path so
+        #: lru_evict / lru_reclaim ride the flight recorder
+        self.on_event = None
         #: high-water mark of blocks in use (serve_bench's occupancy row)
         self.peak_used = 0
+        self.lru_evictions = 0
+        self.lru_reclaims = 0
+
+    def _event(self, kind: str, **payload) -> None:
+        if self.on_event is not None:
+            self.on_event(kind, **payload)
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free + lazily-reclaimable LRU."""
+        return len(self._free) + len(self._lru)
 
     @property
     def used_blocks(self) -> int:
-        return len(self._owned)
+        """Blocks referenced by at least one live sequence."""
+        return len(self._ref)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Refcount-0 blocks held warm on the LRU list."""
+        return len(self._lru)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def reset_stats(self) -> None:
+        self.lru_evictions = 0
+        self.lru_reclaims = 0
 
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= self.free_blocks
+
+    def headroom_excluding(self, blocks: list[int]) -> int:
+        """Allocatable count once ``blocks`` are retained: their LRU
+        entries stop being reclaimable. Lets admission decide
+        hit-plus-tail feasibility BEFORE touching any state, so
+        backpressure retries are true no-ops (no phantom reclaim
+        events, no LRU reordering)."""
+        return self.free_blocks - sum(1 for b in blocks if b in self._lru)
 
     def alloc(self, n: int) -> list[int]:
-        """-> ``n`` block ids; raises PoolExhausted leaving the free
-        list untouched (the all-or-nothing contract admission needs)."""
-        if n > len(self._free):
+        """-> ``n`` fresh (refcount-1, unshared) block ids; raises
+        PoolExhausted leaving free list, LRU, and index untouched (the
+        all-or-nothing contract admission needs). Reclaims LRU blocks
+        lazily — oldest first, index entry dropped — only when the
+        free list alone cannot cover ``n``."""
+        if n > self.free_blocks:
             raise PoolExhausted(
-                f"need {n} blocks, {len(self._free)} free "
-                f"({len(self._owned)} in use of {self.pool.n_blocks - 1})"
+                f"need {n} blocks, {len(self._free)} free + "
+                f"{len(self._lru)} cached ({len(self._ref)} in use of "
+                f"{self.pool.n_blocks - 1})"
             )
+        while len(self._free) < n:
+            block, _ = self._lru.popitem(last=False)
+            self._free.append(block)
+            self.lru_evictions += 1
+            self._event("lru_evict", block=block)
+            if self.cache is not None:
+                # dropping a chain block orphans its descendants (they
+                # are only matchable through it): their index entries
+                # cascade out with it, and any parked on the LRU become
+                # plain free blocks instead of dead warm weight
+                for orphan in self.cache.forget(block):
+                    if orphan != block and orphan in self._lru:
+                        del self._lru[orphan]
+                        self._free.append(orphan)
+                        self.lru_evictions += 1
+                        self._event("lru_evict", block=orphan)
         out = [self._free.pop() for _ in range(n)]
-        self._owned.update(out)
-        self.peak_used = max(self.peak_used, len(self._owned))
+        for b in out:
+            self._ref[b] = 1
+        self.peak_used = max(self.peak_used, len(self._ref))
         return out
 
-    def free(self, blocks: list[int]) -> None:
+    def retain(self, blocks: list[int]) -> int:
+        """Bump each block's refcount (a prefix hit sharing them with a
+        new sequence). Refcount-0 blocks are revived OFF the LRU list
+        (-> the ``lru_reclaim`` lifecycle event). -> how many were
+        revived."""
+        revived = 0
         for b in blocks:
-            if b not in self._owned:
+            if b in self._ref:
+                self._ref[b] += 1
+            elif b in self._lru:
+                del self._lru[b]
+                self._ref[b] = 1
+                revived += 1
+            else:
                 raise ValueError(
-                    f"free of block {b} not handed out by this allocator"
+                    f"retain of block {b} neither live nor cached"
                 )
-            self._owned.discard(b)
-            self._free.append(b)
+        if revived:
+            self.lru_reclaims += revived
+            self._event("lru_reclaim", blocks=revived)
+        self.peak_used = max(self.peak_used, len(self._ref))
+        return revived
+
+    def release(self, blocks: list[int]) -> None:
+        """Drop one reference per block (retirement/drain). A block
+        reaching refcount 0 parks on the LRU list if it is registered
+        in the prefix index (and LRU is on), else returns to the free
+        list. A sequence's blocks park TAIL-first (deepest chain block
+        oldest), so eviction pressure shaves chains from the tail and
+        preserves the shorter — more widely shared — prefixes.
+        Releasing an already-free block raises — refcounts make the
+        double-release hazard checkable."""
+        for b in reversed(list(blocks)):
+            rc = self._ref.get(b)
+            if rc is None:
+                raise ValueError(
+                    f"release of block {b} not handed out by this "
+                    "allocator (double release?)"
+                )
+            if rc > 1:
+                self._ref[b] = rc - 1
+                continue
+            del self._ref[b]
+            if (
+                self.cache is not None
+                and self.cache.is_cached(b)
+                and self.lru_enabled
+            ):
+                self._lru[b] = None
+            else:
+                if self.cache is not None:
+                    for orphan in self.cache.forget(b):
+                        if orphan != b and orphan in self._lru:
+                            del self._lru[orphan]
+                            self._free.append(orphan)
+                self._free.append(b)
+
+    def free(self, blocks: list[int]) -> None:
+        """Strict EXCLUSIVE free: every block must be live with
+        refcount exactly 1. Raises loudly — checking ALL blocks before
+        mutating anything — on an already-free block (double free), a
+        duplicate within ``blocks`` (double free in one call: the old
+        free list took it twice and handed it to two owners), or a
+        SHARED block (refcount > 1: returning it would corrupt another
+        sequence's cache mid-read). Shared lifetimes go through
+        ``release``."""
+        seen: set[int] = set()
+        for b in blocks:
+            rc = self._ref.get(b)
+            if rc is None or b in seen:
+                raise ValueError(
+                    f"free of block {b} not handed out by this allocator "
+                    "(double free?)"
+                )
+            if rc > 1:
+                raise ValueError(
+                    f"free of SHARED block {b} (refcount {rc}): freeing "
+                    "would corrupt the other owners' cache; use release()"
+                )
+            seen.add(b)
+        self.release(blocks)

@@ -12,7 +12,13 @@ scheduler answers every client's generation request from one engine:
     a retirement, it is never dropped;
   - admitted prompts prefill in fixed chunks, one chunk per request per
     tick, so a long prompt shares the host loop with live decode
-    instead of stalling it;
+    instead of stalling it; with the prefix cache on
+    (``serving { prefix_cache { enabled } }``) admission first points
+    the new sequence's block table at the pool's longest cached
+    block-prefix of its prompt, so the chunk loop starts at the first
+    UNCOVERED token — prefill work drops to the uncached tail, and the
+    fully-prefilled prompt is registered for future hits once its last
+    chunk lands;
   - every live slot advances one token per tick through the engine's
     single fixed-shape decode program; EOS or an exhausted budget
     retires the slot (blocks freed, available to the next admit — the
@@ -104,6 +110,16 @@ class Scheduler:
             )
         self.spec_drafted = 0
         self.spec_accepted = 0
+        #: prefix-cache accounting (all zero with the cache off)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.blocks_shared = 0
+        self.cow_copies = 0
+        self.prefill_chunks = 0
+        self.prefill_chunks_saved = 0
+        # allocator lifecycle (lru_evict/lru_reclaim) rides the same
+        # event path as the scheduler's own admissions
+        engine.allocator.on_event = self._event
         self._queue: collections.deque[Request] = collections.deque()
         self._slot_req: dict[int, Request] = {}
         self.ticks = 0
@@ -130,6 +146,10 @@ class Scheduler:
         self.ticks = self.decode_ticks = 0
         self.tokens_emitted = 0
         self.spec_drafted = self.spec_accepted = 0
+        self.prefix_lookups = self.prefix_hits = 0
+        self.blocks_shared = self.cow_copies = 0
+        self.prefill_chunks = self.prefill_chunks_saved = 0
+        self.engine.allocator.reset_stats()
         self._live_ticks = 0
         self.backpressure_ticks = 0
         self.full_tick_s, self.full_tick_tokens = 0.0, 0
@@ -176,8 +196,9 @@ class Scheduler:
         while self._queue and free:
             req = self._queue[0]
             try:
-                blocks = self.engine.admit(
-                    free[0], len(req.prompt) + req.max_new_tokens
+                adm = self.engine.admit(
+                    free[0], len(req.prompt) + req.max_new_tokens,
+                    prompt=req.prompt,
                 )
             except PoolExhausted:
                 stalled = True
@@ -187,7 +208,10 @@ class Scheduler:
             self._slot_req[slot] = req
             req.slot = slot
             req.status = "prefill"
-            req._prefilled = 0
+            # prefill starts at the first token the prefix cache did
+            # not cover (lane positions are seeded by pos0 each chunk,
+            # so a hit just skips the covered chunks)
+            req._prefilled = adm.prefill_from
             # a handed-back (drained) request restarts from scratch on
             # re-admission: its partial output was delivered at evict
             # time, regeneration must not append to it
@@ -196,9 +220,34 @@ class Scheduler:
             req.admit_mono = time.perf_counter()
             self._event(
                 "request_admit", rid=req.rid, slot=slot,
-                prompt_len=int(len(req.prompt)), blocks=len(blocks),
+                prompt_len=int(len(req.prompt)), blocks=len(adm.blocks),
                 queued_s=round(req.admit_mono - req.enqueue_mono, 6),
             )
+            if self.engine.allocator.cache is not None:
+                self.prefix_lookups += 1
+            if adm.cached_tokens:
+                c = self.engine.serving.max_prefill_chunk
+                saved = (
+                    -(-len(req.prompt) // c)
+                    - -(-(len(req.prompt) - adm.prefill_from) // c)
+                )
+                self.prefix_hits += 1
+                # blocks this sequence reads through another owner's
+                # bytes (a COW'd tail block became private)
+                shared = (
+                    adm.cached_tokens // self.engine.pool.block_len
+                    - (1 if adm.cow_copied else 0)
+                )
+                self.blocks_shared += shared
+                self.prefill_chunks_saved += saved
+                self._event(
+                    "prefix_hit", rid=req.rid, slot=slot,
+                    cached_tokens=int(adm.cached_tokens),
+                    blocks_shared=int(shared), chunks_saved=int(saved),
+                )
+            if adm.cow_copied:
+                self.cow_copies += 1
+                self._event("cow_copy", rid=req.rid, slot=slot)
         if stalled:
             self.backpressure_ticks += 1
             self._event(
@@ -223,11 +272,15 @@ class Scheduler:
                 req._prefilled,
             )
             req._prefilled += n
+            self.prefill_chunks += 1
             self._event(
                 "prefill", rid=req.rid, slot=slot, tokens=int(n),
                 done=int(req._prefilled), of=int(len(req.prompt)),
             )
             if req._prefilled >= len(req.prompt):
+                # every prompt position is now prefill-written: index
+                # the fully-covered blocks for future prefix hits
+                self.engine.register_prefix(slot, req.prompt)
                 first = self.engine.activate(
                     slot, last, len(req.prompt), req.seed,
                     temperature=req.temperature,
@@ -422,4 +475,17 @@ class Scheduler:
             out["tokens_per_tick"] = round(
                 self.tokens_emitted / max(1, self.decode_ticks), 4
             )
+        alloc = self.engine.allocator
+        if alloc.cache is not None:
+            out["prefix_hits"] = self.prefix_hits
+            out["prefix_hit_rate"] = round(
+                self.prefix_hits / max(1, self.prefix_lookups), 4
+            )
+            out["blocks_shared"] = self.blocks_shared
+            out["cow_copies"] = self.cow_copies
+            out["prefill_chunks"] = self.prefill_chunks
+            out["prefill_chunks_saved"] = self.prefill_chunks_saved
+            out["lru_evictions"] = alloc.lru_evictions
+            out["lru_reclaims"] = alloc.lru_reclaims
+            out["kv_blocks_cached"] = alloc.cached_blocks
         return out

@@ -259,6 +259,20 @@ def summarize(records: list[dict]) -> dict:
         if r.get("kind") == "spec_accept" and isinstance(r.get("data"), dict)
     )
 
+    # prefix cache: per-admission prefix_hit events carry shared-block
+    # and saved-prefill-chunk counts (serve/scheduler.py _admit_some)
+    prefix_hit_events = [
+        r["data"]
+        for r in life
+        if r.get("kind") == "prefix_hit" and isinstance(r.get("data"), dict)
+    ]
+    blocks_shared = sum(
+        int(d.get("blocks_shared", 0)) for d in prefix_hit_events
+    )
+    prefill_chunks_saved = sum(
+        int(d.get("chunks_saved", 0)) for d in prefix_hit_events
+    )
+
     faults = [
         r["data"].get("fault")
         for r in life
@@ -351,6 +365,26 @@ def summarize(records: list[dict]) -> dict:
             else None,
             "spec_drafted": spec_drafted,
             "spec_accepted": spec_accepted,
+            # prefix cache: hit rate over admissions, shared blocks,
+            # and prefill chunks the hits skipped (None = no prefix
+            # lifecycle events in this log — cache off or no hits)
+            "prefix_hit_rate": round(
+                len(prefix_hit_events)
+                / max(1, counts.get("request_admit", 0)),
+                4,
+            )
+            if prefix_hit_events
+            else None,
+            "blocks_shared": blocks_shared,
+            "prefill_chunks_saved": prefill_chunks_saved,
+            "cow_copies": counts.get("cow_copy", 0),
+            "lru_evictions": counts.get("lru_evict", 0),
+            "lru_reclaims": sum(
+                int(r["data"].get("blocks", 1))
+                for r in life
+                if r.get("kind") == "lru_reclaim"
+                and isinstance(r.get("data"), dict)
+            ),
             "admitted": counts.get("request_admit", 0),
             "retired": counts.get("retire", 0),
             "evicted": counts.get("evict", 0),
