@@ -586,6 +586,69 @@ def bench_lm_d128_prefix():
     }
 
 
+def bench_lm_d128_fusedattn():
+    """Fused paged attention on the serving shape: the same engine as
+    `lm_d128_serve` with `kernels { paged_attention: fused }` — the
+    Pallas kernel reading K/V blocks in place through the block table
+    (interpret mode off-TPU). `tokens_per_s` is the row value;
+    `attn_bytes_ratio` is the deterministic number the row exists to
+    pin — modeled attention bytes accessed, reference dense-gather
+    path over fused block-tile reads (tools/attend_stall.py's gated
+    arm; a regression in the kernel's fetch clamping or the reference
+    gather moves it). On this CPU host the kernel runs interpreted, so
+    wall-clock `tokens_per_s` trails `lm_d128_serve` by construction —
+    identity (token_mismatches == 0 vs the reference-path baselines)
+    and the bytes model are what regress-guard here, which is exactly
+    what attend_stall's or-gate enforces in CI."""
+    import io
+    from contextlib import redirect_stdout
+
+    import jax
+
+    from singa_tpu.models.transformer import TransformerConfig, init_lm
+    from singa_tpu.tools import serve_bench
+    from singa_tpu.tools.attend_stall import (
+        build_argparser as as_parser,
+        measure_attend_bytes,
+    )
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        serve_bench.main([
+            "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+            "--requests", "8", "--max_new", "16", "--no_gate",
+            "--kernels", "fused",
+        ])
+    r = json.loads(buf.getvalue().strip().splitlines()[-1])
+    st = as_parser().parse_args([
+        "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+        "--max_new", "16",
+    ])
+    cfg = TransformerConfig(
+        vocab=st.vocab, d_model=st.d_model, n_heads=st.n_heads,
+        n_layers=st.n_layers, d_ff=st.d_ff, max_len=st.max_len,
+    )
+    by = measure_attend_bytes(
+        init_lm(jax.random.PRNGKey(st.seed), cfg), cfg, st
+    )
+    return {
+        "name": "lm_d128_fusedattn",
+        "value": r["tokens_per_s"],
+        "unit": "tokens/sec",
+        "tokens_per_s": r["tokens_per_s"],
+        "kernels": r.get("kernels"),
+        "attn_bytes_ratio": by["bytes_ratio"],
+        "attn_ref_bytes": by["ref_bytes"],
+        "attn_fused_bytes": by["fused_bytes"],
+        "p50_ms": r["p50_ms"],
+        "p99_ms": r["p99_ms"],
+        "speedup": r.get("speedup"),
+        "token_mismatches": r.get("token_mismatches"),
+        "method": "serve_bench --kernels fused (request wall clock) + "
+        "attend_stall modeled-bytes probe",
+    }
+
+
 BENCHES = (
     ("mnist_mlp", bench_mnist_mlp),
     ("cifar_alexnet", bench_cifar_alexnet),
@@ -599,6 +662,7 @@ BENCHES = (
     ("lm_d128_serve", bench_lm_d128_serve),
     ("lm_d128_spec", bench_lm_d128_spec),
     ("lm_d128_prefix", bench_lm_d128_prefix),
+    ("lm_d128_fusedattn", bench_lm_d128_fusedattn),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),
     ("mnist_mlp_replica", bench_mnist_mlp_replica),

@@ -774,6 +774,40 @@ class ServingConfig(Message):
     }
 
 
+KERNEL_IMPLS = ("reference", "fused")
+
+
+class KernelsConfig(Message):
+    """singa-tpu extension: per-site kernel implementation selection
+    (the Pallas hot-path seam, singa_tpu/ops/paged_attention.py).
+
+    ``paged_attention: fused`` swaps the serving engine's attention —
+    every decode tick, prefill chunk, and speculative verify pass —
+    from the reference gather -> ``cache_attend`` path onto a Pallas
+    kernel that reads K/V blocks IN PLACE through the block table
+    (flash-attention online-softmax tiling over block-granular K/V, no
+    dense ``(slots, heads, cache_len, head_dim)`` materialization per
+    layer). Output is allclose to the reference (online softmax
+    reorders the reduction); greedy token streams are identical.
+    ``reference`` (default, = no block) keeps the bitwise-pinned
+    oracle path untouched. ``interpret`` (default true) runs the
+    kernel through the Pallas interpreter — plain XLA ops, CPU-safe
+    and GSPMD-shardable, what CI exercises — set false on a real TPU
+    to compile through Mosaic, which constrains the geometry
+    (kv_block_len a multiple of 8, head_dim a multiple of 128; the
+    engine rejects violations at construction, netlint KRN001 flags
+    them statically)."""
+
+    FIELDS = {
+        # serving-tier attention: "reference" gather + cache_attend
+        # oracle, "fused" Pallas paged-attention kernel
+        "paged_attention": Field("enum", "reference", enum=KERNEL_IMPLS),
+        # run the fused kernel in the Pallas interpreter (CPU-safe);
+        # false = compile through Mosaic (TPU, geometry-gated)
+        "interpret": Field("bool", True),
+    }
+
+
 class TelemetryConfig(Message):
     """singa-tpu extension: the flight-recorder telemetry plane
     (singa_tpu/obs/). Always-on by default — a job with a workspace
@@ -864,6 +898,10 @@ class ModelConfig(Message):
         # continuous-batching inference with a paged KV cache. Absent =
         # serving defaults (tools/serve_bench.py, tools/generate.py) ---
         "serving": Field("message", message=ServingConfig),
+        # --- singa-tpu extension: per-site kernel selection (Pallas
+        # hot paths, singa_tpu/ops/paged_attention.py). Absent = every
+        # site runs its reference oracle path ---
+        "kernels": Field("message", message=KernelsConfig),
     }
 
 

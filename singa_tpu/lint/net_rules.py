@@ -71,6 +71,12 @@ SRV001 = rule(
     ERROR,
     "prefix_cache enabled but kv_blocks cannot hold one max-length prompt",
 )
+KRN001 = rule(
+    "KRN001",
+    ERROR,
+    "fused paged_attention selected with a geometry the compiled "
+    "kernel cannot tile",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -423,6 +429,74 @@ def serving_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
         )
 
 
+def kernel_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
+    """KRN001 — static mirror of the serving engine's fused-kernel
+    geometry rejection (serve/engine.py consults the SAME
+    ops.paged_attention.fusable predicate at construction). A conf
+    that selects ``kernels { paged_attention: fused, interpret: false }``
+    with a ``kv_block_len`` or head_dim the compiled (Mosaic) kernel
+    cannot tile would reject at engine build time, after pod time is
+    already burned; flag it at lint time instead. Interpret mode tiles
+    anything, so ``interpret: true`` (the default) never fires. The
+    head_dim comes from the conf's declared dims — the kEmbedding
+    layer's ``embedding_dim`` over the kAttention layer's
+    ``num_heads`` — and is skipped when either is undeclared (not
+    statically decidable, like SRV001's window)."""
+    kern = getattr(model_cfg, "kernels", None)
+    if kern is None or kern.paged_attention != "fused" or kern.interpret:
+        return
+    from ..ops.paged_attention import fusable
+
+    srv = getattr(model_cfg, "serving", None)
+    block_len = srv.kv_block_len if srv is not None else (
+        schema.ServingConfig.FIELDS["kv_block_len"].default
+    )
+    head_dim = 0
+    net_cfg = model_cfg.neuralnet
+    if net_cfg is not None:
+        dim = max(
+            (
+                l.embedding_param.embedding_dim
+                for l in net_cfg.layer
+                if l.embedding_param is not None
+            ),
+            default=0,
+        )
+        heads = max(
+            (
+                l.attention_param.num_heads
+                for l in net_cfg.layer
+                if l.attention_param is not None
+            ),
+            default=0,
+        )
+        if dim and heads and dim % heads == 0:
+            head_dim = dim // heads
+    # check each declared dimension independently (a missing head_dim
+    # must not mask an untileable block_len and vice versa), but dedupe
+    # dimension-independent reasons — a missing pallas install is ONE
+    # problem, not one per probed dim
+    reasons = dict.fromkeys(
+        r
+        for r in (
+            fusable(block_len, 128, interpret=False),
+            fusable(8, head_dim, interpret=False) if head_dim else None,
+        )
+        if r is not None
+    )
+    for reason in reasons:
+        col.emit(
+            KRN001,
+            path,
+            f"kernels.paged_attention 'fused' with interpret off, but "
+            f"{reason} — the engine will reject this config at "
+            "construction",
+            fix_hint="pick a tileable geometry (kv_block_len % 8 == 0, "
+            "head_dim % 128 == 0), or set kernels { interpret: true }, "
+            "or keep paged_attention: reference",
+        )
+
+
 # ---------------------------------------------------------------------------
 # sharding rules (model conf x cluster axis widths)
 # ---------------------------------------------------------------------------
@@ -547,6 +621,7 @@ def lint_model_text(
         return None
     graph_rules(model_cfg, path, col)
     serving_rules(model_cfg, path, col)
+    kernel_rules(model_cfg, path, col)
     if widths:
         sharding_rules_static(model_cfg, widths, path, col)
     return model_cfg

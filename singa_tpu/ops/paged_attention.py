@@ -1,0 +1,324 @@
+"""Fused paged attention: a Pallas kernel that reads K/V blocks IN
+PLACE through the block table.
+
+The serving engine's reference attention path is gather -> attend ->
+scatter: every decode tick, prefill chunk, and verify pass first
+materializes a dense ``(slots, heads, cache_len, head_dim)`` view of
+the paged pool PER LAYER (``Engine._gather``) before ``cache_attend``
+runs — for a pool that is mostly shared prefix blocks and trash
+padding, that materialization is the serving tier's main memory
+traffic. This kernel removes it: the per-sequence block table rides in
+as a scalar-prefetch operand, the grid's block dimension maps each
+step straight at the sequence's next pool block (``BlockSpec`` index
+map = a table lookup), and masked online-softmax statistics accumulate
+across grid steps in VMEM scratch — flash-attention tiling over
+block-granular K/V, the PagedAttention idea from vLLM-style serving.
+No dense ``(S, H, C, D)`` intermediate ever exists.
+
+Two entry points cover the engine's three call shapes:
+
+``paged_attention``
+    write-then-read — the decode tick ``(slots, 1)`` and chunked
+    prefill ``(1, chunk)`` pattern: the fresh K/V were already
+    scattered into the pool (padding/dead lanes to the trash block),
+    so every attended entry lives behind the table and the mask is
+    ``cache_attend``'s exactly: pool position <= query position.
+
+``paged_attention_overlay``
+    the speculative verify ``(slots, k+1)`` pattern: the pool must NOT
+    be written before acceptance is known (KV rewind is "rejected
+    positions were never written"), so the chunk's fresh K/V ride as a
+    separate operand attended after the pool blocks — pool entries
+    strictly BEFORE the chunk, chunk columns causally within it, the
+    same split the reference path's gathered-view ``.at[].set``
+    overlay encodes.
+
+Masking discipline is inherited from ``cache_attend``: out-of-range
+entries score ``NEG_INF`` (-1e30, finite — ``exp(m - m)`` stays 1 on
+fully-masked rows) and their probabilities are zeroed explicitly, so
+trash-block garbage and stale pool bytes never move an output bit. A
+fully-masked query row emits zeros (the ``l == 0`` guard), where the
+reference emits a uniform average of masked garbage — both are
+garbage no caller reads (dead slots / padding queries), documented
+rather than matched.
+
+Parity with the reference is TOLERANCE-LEVEL, not bitwise: online
+softmax reorders the reduction (blockwise running max/sum vs one
+global softmax), the same cross-shape caveat PR 9 documents for XLA's
+own re-tiled GEMM accumulation. Greedy token STREAMS are pinned
+identical in tests — argmax decisions survive reduction-order ulps on
+every workload the suite drives.
+
+Bytes skipped, not just bytes reorganized: the causal bound clamps the
+fetch index map so blocks past a sequence's live range re-fetch the
+previous block id — Pallas skips the DMA when consecutive grid steps
+map to the same block — and ``pl.when`` skips their compute.
+
+``interpret=True`` (the default, and what CPU CI runs) executes the
+kernel through the Pallas interpreter — plain XLA ops, so the
+masking/online-softmax logic is tested on every run and the kernel
+composes with GSPMD sharding (``serving_kv_shardings`` lays the pool's
+heads over the model axis; the grid's ``S*H`` dimension partitions
+with it). ``interpret=False`` compiles through Mosaic for a real TPU
+and constrains the geometry (``fusable``): the K/V block tile must
+align to the (8, 128) float32 register tile, i.e. ``kv_block_len`` a
+multiple of 8 and ``head_dim`` a multiple of 128. netlint's KRN001 is
+the static mirror of that rejection.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF
+
+try:  # soft import, like ops/attention: CPU wheels ship pallas too,
+    # but a missing extra must degrade to a loud config error, not an
+    # import-time crash of the whole serve package
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+
+#: hardware tile floor for the compiled (interpret=False) kernel: the
+#: K/V block tile is (block_len, head_dim) float32 — sublanes of 8,
+#: lanes of 128 (pallas_guide "Tiling Constraints")
+_SUBLANE, _LANE = 8, 128
+
+
+def fusable(block_len: int, head_dim: int, interpret: bool = True):
+    """None if the kernel can serve this geometry, else the reason it
+    cannot — the ONE tiling predicate the engine's runtime rejection
+    and netlint's KRN001 both consult (a static mirror must never
+    drift from the thing it mirrors)."""
+    if not HAS_PALLAS:
+        return "jax.experimental.pallas is unavailable in this environment"
+    if block_len < 1:
+        return f"kv_block_len {block_len} < 1"
+    if interpret:
+        return None  # the interpreter tiles anything
+    if block_len % _SUBLANE:
+        return (
+            f"kv_block_len {block_len} not a multiple of {_SUBLANE} "
+            f"(the fp32 sublane tile): the compiled kernel cannot tile "
+            "the pool's block dimension"
+        )
+    if head_dim % _LANE:
+        return (
+            f"head_dim {head_dim} not a multiple of {_LANE} (the lane "
+            "tile): the compiled kernel cannot tile the head dimension"
+        )
+    return None
+
+
+def _kernel(
+    tab_ref, nlive_ref,
+    q_ref, k_ref, v_ref, pos_ref, *rest,
+    block_len, n_heads, mb, per_query_pool_mask, has_chunk,
+):
+    """One (sequence*head, pool-block) program.
+
+    Grid iterates the block dimension innermost and sequentially, so
+    the flash (acc, m, l) statistics live in VMEM scratch across steps
+    of the same (s, h) row: initialized at b == 0, folded per live
+    block, normalized at b == mb - 1 (where the overlay chunk, if any,
+    is folded last — online softmax is order-free).
+
+    ``per_query_pool_mask``: True = write-then-read (pool position <=
+    query position, cache_attend's mask); False = overlay (pool
+    position strictly < the chunk's first position — every query sees
+    every pool entry, the chunk columns carry [pos0, pos0+Q)).
+    """
+    if has_chunk:
+        ck_ref, cv_ref, valid_ref, o_ref, acc, m, l = rest
+    else:
+        o_ref, acc, m, l = rest
+    b = pl.program_id(1)
+    s = pl.program_id(0) // n_heads
+    q = q_ref[0, 0].astype(jnp.float32)            # (Q, D)
+    pos = pos_ref[0]                               # (Q,) int32
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def fold(scores, mask, values):
+        """One online-softmax update of the running (acc, m, l)."""
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_prev = m[0]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(scores - m_new[:, None]), 0.0)
+        acc[...] = acc[...] * alpha[:, None] + p @ values
+        l[0] = l[0] * alpha + jnp.sum(p, axis=-1)
+        m[0] = m_new
+
+    @pl.when(b == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m[...] = jnp.full_like(m, NEG_INF)
+        l[...] = jnp.zeros_like(l)
+
+    @pl.when(b < nlive_ref[s])
+    def _pool_block():
+        k = k_ref[0, 0].astype(jnp.float32)        # (BL, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        kpos = b * block_len + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_len), 1
+        )[0]
+        if per_query_pool_mask:
+            mask = kpos[None, :] <= pos[:, None]   # (Q, BL)
+        else:
+            mask = jnp.broadcast_to(
+                kpos[None, :] < pos[0], (q.shape[0], block_len)
+            )
+        fold((q @ k.T) * scale, mask, v)
+
+    @pl.when(b == mb - 1)
+    def _finish():
+        if has_chunk:
+            ck = ck_ref[0, 0].astype(jnp.float32)  # (Q, D)
+            cv = cv_ref[0, 0].astype(jnp.float32)
+            vld = valid_ref[0] != 0
+            # column jj holds the entry AT position pos[jj]: causal
+            # within the chunk, padding/rejected columns masked out
+            mask = (pos[None, :] <= pos[:, None]) & vld[None, :]
+            fold((q @ ck.T) * scale, mask, cv)
+        safe = jnp.where(l[0] == 0.0, 1.0, l[0])
+        o_ref[0, 0] = (acc[...] / safe[:, None]).astype(o_ref.dtype)
+
+
+def live_blocks(last_position, block_len, max_blocks):
+    """Blocks the kernel's clamped grid actually fetches for one
+    sequence whose last attended POOL position is ``last_position``
+    (= ceil((last_position + 1) / block_len), clipped to the table
+    width; -1 = no pool blocks). The ONE formula shared by the kernel
+    (``_call``'s nlive) and the bytes model tools/attend_stall.py
+    gates on — keeping the gated model in lockstep with what the
+    kernel fetches. Works on scalars and arrays."""
+    return jnp.clip((last_position + block_len) // block_len, 0, max_blocks)
+
+
+def _call(q, k_pool, v_pool, tables, positions, chunk, interpret):
+    s, h, nq, d = q.shape
+    _, _, bl, _ = k_pool.shape
+    mb = tables.shape[1]
+    reason = fusable(bl, d, interpret=bool(interpret))
+    if reason is not None:
+        raise ValueError(f"paged_attention cannot run: {reason}")
+    if chunk is None:
+        # write-then-read: blocks must cover every query position
+        live_to = jnp.max(positions, axis=1)
+    else:
+        # overlay: blocks cover strictly-before-the-chunk positions
+        live_to = positions[:, 0] - 1
+    nlive = live_blocks(live_to, bl, mb).astype(jnp.int32)
+    tflat = tables.reshape(-1).astype(jnp.int32)
+
+    def kmap(i, b, tref, nref):
+        # clamp dead iterations at the last live block: the repeated
+        # index lets the grid pipeline skip the re-fetch, pl.when
+        # skips the compute — bytes saved, not just masked
+        row = i // h
+        bb = jnp.minimum(b, jnp.maximum(nref[row] - 1, 0))
+        return (tref[row * mb + bb], i % h, 0, 0)
+
+    qspec = pl.BlockSpec(
+        (1, 1, nq, d), lambda i, b, t, n: (i // h, i % h, 0, 0)
+    )
+    rowspec = pl.BlockSpec((1, nq), lambda i, b, t, n: (i // h, 0))
+    kvspec = pl.BlockSpec((1, 1, bl, d), kmap)
+    in_specs = [qspec, kvspec, kvspec, rowspec]
+    args = [q, k_pool, v_pool, positions.astype(jnp.int32)]
+    if chunk is not None:
+        ck, cv, valid = chunk
+        in_specs += [qspec, qspec, rowspec]
+        args += [ck, cv, valid.astype(jnp.int32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s * h, mb),
+        in_specs=in_specs,
+        out_specs=qspec,
+        scratch_shapes=[
+            pltpu.VMEM((nq, d), jnp.float32),      # acc
+            pltpu.VMEM((1, nq), jnp.float32),      # m (running rowmax)
+            pltpu.VMEM((1, nq), jnp.float32),      # l (running rowsum)
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _kernel,
+            block_len=bl, n_heads=h, mb=mb,
+            per_query_pool_mask=chunk is None,
+            has_chunk=chunk is not None,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=bool(interpret),
+    )(tflat, nlive, *args)
+
+
+def modeled_bytes(
+    n_seqs: int, n_heads: int, n_queries: int, head_dim: int,
+    block_len: int, live_blocks_total: int, *, overlay: bool = False,
+    itemsize: int = 4,
+) -> int:
+    """The kernel's modeled bytes accessed for one invocation — what a
+    ``pl.CostEstimate`` declares on hardware: Q in, the LIVE K/V block
+    tiles the clamped grid actually fetches (dead iterations re-fetch
+    the previous block and Pallas skips the DMA), the overlay chunk if
+    any, and O out. ``live_blocks_total`` is the sum over sequences of
+    each one's live-block count (what ``_call`` computes as ``nlive``).
+
+    This is the deterministic arm of tools/attend_stall.py's or-gate:
+    the XLA cost analysis of the INTERPRETED kernel models the
+    emulation's bookkeeping (whole-buffer loop carries), not the
+    kernel's memory traffic, so the comparison against the reference
+    path's dense gather uses this model instead — block-tile reads vs
+    the ``(slots, H, cache_len, D)`` materialization."""
+    qo = 2 * n_seqs * n_heads * n_queries * head_dim * itemsize
+    kv = 2 * live_blocks_total * n_heads * block_len * head_dim * itemsize
+    chunk = (
+        2 * n_seqs * n_heads * n_queries * head_dim * itemsize
+        if overlay else 0
+    )
+    return qo + kv + chunk
+
+
+def paged_attention(
+    q, k_pool, v_pool, tables, positions, *, interpret=True
+):
+    """Masked paged attention, write-then-read form.
+
+    ``q`` (S, H, Q, D) queries at absolute ``positions`` (S, Q);
+    ``k_pool``/``v_pool`` (n_blocks, H, block_len, D) pools already
+    holding every attended entry (the fresh chunk was scattered in,
+    padding to the trash block); ``tables`` (S, max_blocks) block ids.
+    -> (S, H, Q, D), allclose to
+    ``cache_attend(q, gather(k_pool), gather(v_pool), positions)``
+    without the gather's dense intermediate.
+    """
+    return _call(q, k_pool, v_pool, tables, positions, None, interpret)
+
+
+def paged_attention_overlay(
+    q, k_pool, v_pool, tables, positions, chunk_k, chunk_v, chunk_valid,
+    *, interpret=True,
+):
+    """Masked paged attention with the fresh chunk OVERLAID — the
+    verify tick's no-pool-write form (KV rewind by construction).
+
+    ``chunk_k``/``chunk_v`` (S, H, Q, D) hold the K/V of the chunk's
+    own positions (column jj lives at ``positions[s, jj]``);
+    ``chunk_valid`` (S, Q) marks real columns (draft-width/liveness
+    padding rides masked). Pool entries are attended strictly BEFORE
+    ``positions[:, 0]``; the pool is never written here.
+    """
+    return _call(
+        q, k_pool, v_pool, tables, positions,
+        (chunk_k, chunk_v, chunk_valid), interpret,
+    )

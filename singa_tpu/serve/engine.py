@@ -47,6 +47,21 @@ discipline — one split per emitted token — is identical either way).
 Sharding: pass a mesh and the pools lay their heads dim out over the
 ``model`` axis (parallel/shardings.serving_kv_shardings) — the serving
 analog of kLayerPartition; everything else replicates.
+
+ATTENTION IMPLEMENTATION is a per-engine knob (the ``kernels {
+paged_attention }`` model-conf block): ``reference`` (the default)
+keeps the bitwise-pinned gather -> ``cache_attend`` path above;
+``fused`` swaps the Pallas paged-attention kernel
+(ops/paged_attention.py) in at the ``attend`` closure seam of
+``_block_apply`` — K/V blocks are read IN PLACE through the block
+table, no dense ``(S, H, cache_len, D)`` materialization per layer.
+Fused output is allclose to the reference (online softmax reorders the
+reduction — the PR 9 cross-shape caveat at kernel granularity); greedy
+token STREAMS are pinned identical in tests. ``kernels { interpret }``
+(default true) runs the kernel through the Pallas interpreter — plain
+XLA ops, CPU-safe and GSPMD-shardable — set false on a real TPU to
+compile through Mosaic (geometry-gated: see paged_attention.fusable,
+statically mirrored by netlint KRN001).
 """
 
 from __future__ import annotations
@@ -87,12 +102,29 @@ class EngineConfig:
     #: instead of freeing them at retirement; False = share only among
     #: concurrently-live sequences
     prefix_lru: bool = True
+    #: ``kernels { paged_attention }``: "reference" = the gather +
+    #: cache_attend oracle path (bitwise-pinned, the default); "fused"
+    #: = the Pallas kernel reading K/V blocks in place via the block
+    #: table (ops/paged_attention.py)
+    attend_impl: str = "reference"
+    #: ``kernels { interpret }``: run the fused kernel through the
+    #: Pallas interpreter (plain XLA ops — CPU-safe, GSPMD-shardable;
+    #: what CI exercises). False compiles through Mosaic on a real TPU
+    #: and constrains the geometry (paged_attention.fusable / KRN001).
+    interpret: bool = True
 
     @classmethod
-    def from_conf(cls, serving) -> "EngineConfig":
-        """From a parsed ``serving { ... }`` config block (None = defaults)."""
+    def from_conf(cls, serving, kernels=None) -> "EngineConfig":
+        """From parsed ``serving { ... }`` / ``kernels { ... }`` config
+        blocks (None = defaults)."""
+        kw = {}
+        if kernels is not None:
+            kw = dict(
+                attend_impl=kernels.paged_attention,
+                interpret=kernels.interpret,
+            )
         if serving is None:
-            return cls()
+            return cls(**kw)
         spec = serving.speculate
         pc = serving.prefix_cache
         return cls(
@@ -104,6 +136,7 @@ class EngineConfig:
             spec_drafter=spec.drafter if spec is not None else "ngram",
             prefix_cache=pc.enabled if pc is not None else False,
             prefix_lru=pc.lru if pc is not None else True,
+            **kw,
         )
 
 
@@ -137,6 +170,24 @@ class Engine:
         self.cfg = cfg
         self.serving = serving or EngineConfig()
         self.temperature = float(temperature)
+        if self.serving.attend_impl not in ("reference", "fused"):
+            raise ValueError(
+                f"kernels.paged_attention must be 'reference' or "
+                f"'fused', got {self.serving.attend_impl!r}"
+            )
+        self._fused = self.serving.attend_impl == "fused"
+        if self._fused:
+            from ..ops.paged_attention import fusable
+
+            reason = fusable(
+                self.serving.kv_block_len, cfg.head_dim,
+                interpret=self.serving.interpret,
+            )
+            if reason is not None:
+                # the runtime rejection KRN001 statically mirrors
+                raise ValueError(
+                    f"kernels {{ paged_attention: fused }}: {reason}"
+                )
         self.pool = KVPool.for_model(
             cfg.max_len, self.serving.kv_block_len,
             self.serving.kv_blocks, self.serving.slots,
@@ -203,11 +254,33 @@ class Engine:
 
     def _gather(self, pool_arr, tables):
         """(NB, H, BL, D) pool + (S', MB) tables -> (S', H, CL, D) dense
-        per-sequence cache views (CL = MB * BL = the dense cache_len)."""
-        g = pool_arr[tables]                      # (S', MB, H, BL, D)
+        per-sequence cache views (CL = MB * BL = the dense cache_len).
+
+        Gather indices are promised in bounds: every table entry is an
+        allocator-issued block id (rows beyond a sequence's allocation
+        hold the trash block, 0), so XLA's per-index clamp — work whose
+        only effect the attend mask would zero anyway — is skipped."""
+        g = pool_arr.at[tables].get(mode="promise_in_bounds")
         g = jnp.moveaxis(g, 2, 1)                 # (S', H, MB, BL, D)
         s, h = g.shape[0], g.shape[1]
         return g.reshape(s, h, self.pool.cache_len, g.shape[-1])
+
+    def _gather_kv(self, kp, vp, tables):
+        """Both dense views of one layer's K and V pools — the ONE
+        helper the reference attends share (decode/prefill/verify each
+        used to spell the pair out)."""
+        return self._gather(kp, tables), self._gather(vp, tables)
+
+    def _paged_attend(self, q, kp, vp, tables, positions):
+        """The fused path's write-then-read attend (decode + prefill):
+        the fresh K/V were already scattered into ``kp``/``vp``, the
+        kernel reads blocks in place through ``tables``."""
+        from ..ops.paged_attention import paged_attention
+
+        return paged_attention(
+            q, kp, vp, tables, positions,
+            interpret=self.serving.interpret,
+        )
 
     def _sample(self, logits, keys, temps, live, prev):
         """Per-slot sampling through the temperature LANE: greedy argmax
@@ -254,12 +327,16 @@ class Engine:
             def attend(q, k, v):
                 kp = state["k"][i].at[bid, :, off].set(k[:, :, 0, :])
                 vp = state["v"][i].at[bid, :, off].set(v[:, :, 0, :])
-                o = cache_attend(
-                    q,
-                    self._gather(kp, state["tables"]),
-                    self._gather(vp, state["tables"]),
-                    pos[:, None],
-                )
+                if self._fused:
+                    o = self._paged_attend(
+                        q, kp, vp, state["tables"], pos[:, None]
+                    )
+                else:
+                    o = cache_attend(
+                        q,
+                        *self._gather_kv(kp, vp, state["tables"]),
+                        pos[:, None],
+                    )
                 return o, (kp, vp)
             return attend
 
@@ -317,12 +394,14 @@ class Engine:
                 vp = state["v"][i].at[bid, :, off].set(
                     jnp.moveaxis(v[0], 1, 0)
                 )
-                o = cache_attend(
-                    q,
-                    self._gather(kp, row[None]),
-                    self._gather(vp, row[None]),
-                    p[None],
-                )
+                if self._fused:
+                    o = self._paged_attend(q, kp, vp, row[None], p[None])
+                else:
+                    o = cache_attend(
+                        q,
+                        *self._gather_kv(kp, vp, row[None]),
+                        p[None],
+                    )
                 return o, (kp, vp)
             return attend
 
@@ -403,6 +482,21 @@ class Engine:
 
         def mk_attend(i):
             def attend(qh, kh, vh):
+                if self._fused:
+                    # the kernel's overlay form IS the rewind contract
+                    # (pool never written before acceptance) at every
+                    # draft width, so kd == 0 needs no special case —
+                    # the post-acceptance scatter routes identically
+                    from ..ops.paged_attention import (
+                        paged_attention_overlay,
+                    )
+
+                    o = paged_attention_overlay(
+                        qh, state["k"][i], state["v"][i],
+                        state["tables"], p, kh, vh, valid,
+                        interpret=self.serving.interpret,
+                    )
+                    return o, (kh, vh)
                 if kd == 0:
                     # zero draft width: rewind is definitionally inert
                     # (nothing can be rejected), so take the decode
@@ -419,8 +513,7 @@ class Engine:
                     )
                     o = cache_attend(
                         qh,
-                        self._gather(kp, state["tables"]),
-                        self._gather(vp, state["tables"]),
+                        *self._gather_kv(kp, vp, state["tables"]),
                         p,
                     )
                     return o, (kp, vp)
@@ -458,8 +551,11 @@ class Engine:
         # the rewind-by-construction scatter: ONLY positions sequential
         # decode would have written (j <= acc, live) land in real
         # blocks; everything else routes to trash. At kd == 0 the
-        # attend already wrote the pool with that exact routing.
-        if kd == 0:
+        # reference attend already wrote the pool with that exact
+        # routing (the fused path never writes in attend, so it takes
+        # the scatter at every draft width — at kd == 0 emit_mask is
+        # exactly ``live``, the same routing).
+        if kd == 0 and not self._fused:
             new_k = [kp for kp, _ in fresh]
             new_v = [vp for _, vp in fresh]
         else:

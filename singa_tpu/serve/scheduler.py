@@ -135,6 +135,14 @@ class Scheduler:
         self.full_tick_s = 0.0
         self.full_tick_tokens = 0
         self.finished: list[Request] = []
+        # run-start provenance: which implementation the attend seam
+        # runs (kernels { paged_attention }), so an incident report can
+        # say which path this run took (trace.py --summarize
+        # serving.attend_impl)
+        self._event(
+            "kernel_select", site="serve.paged_attention",
+            impl=engine.serving.attend_impl,
+        )
 
     def reset_counters(self) -> None:
         """Zero every accumulated statistic (ticks, token/draft counts,

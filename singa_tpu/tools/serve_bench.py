@@ -132,6 +132,14 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="min warm-cache tokens/sec over cold admission "
                     "on the shared_prefix workload (or-gated with the "
                     "deterministic prefill-chunks-executed >= 2x drop)")
+    ap.add_argument("--kernels", default="reference",
+                    choices=("reference", "fused"),
+                    help="serving attention implementation on the "
+                    "measured engine: 'reference' = the gather + "
+                    "cache_attend oracle, 'fused' = the Pallas "
+                    "paged-attention kernel (interpret mode off-TPU; "
+                    "baselines always run reference, so the gate "
+                    "doubles as a stream-identity check)")
     ap.add_argument("--arrival", default="batch",
                     choices=("batch", "poisson"),
                     help="'poisson' adds a seeded open-loop arrival "
@@ -217,7 +225,8 @@ def run_scan_reference(params, cfg, prompts, max_new):
 
 
 def _warmed_scheduler(params, cfg, prompts, args, slots, spec_k,
-                      recorder=None, preemption=None, prefix_cache=False):
+                      recorder=None, preemption=None, prefix_cache=False,
+                      kernels="reference"):
     """Build an engine + scheduler and warm its compiled programs
     (prefill + decode/verify) with a throwaway request, then zero the
     counters — jit caches live per engine instance, so warming a twin
@@ -242,6 +251,7 @@ def _warmed_scheduler(params, cfg, prompts, args, slots, spec_k,
             spec_k=spec_k,
             spec_drafter=args.spec_drafter,
             prefix_cache=prefix_cache,
+            attend_impl=kernels,
         ),
     )
     sched = Scheduler(engine, recorder=None, preemption=preemption)
@@ -263,20 +273,22 @@ def _warmed_scheduler(params, cfg, prompts, args, slots, spec_k,
 
 def run_continuous(params, cfg, prompts, args, slots, recorder=None,
                    preemption=None, sigterm_at_tick=0, spec_k=0,
-                   prefix_cache=False):
+                   prefix_cache=False, kernels="reference"):
     """The serving stack at ``slots`` concurrency (slots=1 IS the
     one-at-a-time baseline: the same engine, streaming each request's
     tokens per tick, nothing batched; ``spec_k`` > 0 routes decode
     through the speculative verify tick; ``prefix_cache`` admits into
-    a cache the warm request pre-seeded). -> (scheduler, elapsed_s,
-    drain accounting | None)."""
+    a cache the warm request pre-seeded; ``kernels`` picks the attend
+    implementation — baselines stay on "reference", so every gate's
+    token-identity bar doubles as a fused-vs-reference stream check).
+    -> (scheduler, elapsed_s, drain accounting | None)."""
 
     from ..serve import Request
 
     _, sched = _warmed_scheduler(
         params, cfg, prompts, args, slots, spec_k,
         recorder=recorder, preemption=preemption,
-        prefix_cache=prefix_cache,
+        prefix_cache=prefix_cache, kernels=kernels,
     )
     for i, pr in enumerate(prompts):
         sched.submit(Request(rid=i, prompt=pr, max_new_tokens=args.max_new,
@@ -400,7 +412,7 @@ def run_poisson(params, cfg, prompts, args, recorder=None):
 
     _, sched = _warmed_scheduler(
         params, cfg, prompts, args, args.concurrency, args.speculate_k,
-        recorder=recorder,
+        recorder=recorder, kernels=args.kernels,
     )
     rs = np.random.RandomState(args.seed + 1)
     arrivals = np.cumsum(rs.exponential(1.0 / max(args.rate, 1e-9),
@@ -453,6 +465,13 @@ def main(argv=None) -> int:
             run_id="serve_bench",
         )
         recorder.event("run_start", step=0, mode="serve_bench")
+        # which implementation the measured engine's attend seam runs
+        # (site -> impl), so trace --summarize's incident report says
+        # which path a run took
+        recorder.event(
+            "kernel_select", step=0, site="serve.paged_attention",
+            impl=args.kernels,
+        )
     handler = PreemptionHandler()
     handler.install()
 
@@ -491,7 +510,7 @@ def main(argv=None) -> int:
         params, cfg, prompts, args, slots=args.concurrency,
         recorder=recorder, preemption=handler,
         sigterm_at_tick=args.sigterm_at_tick, spec_k=args.speculate_k,
-        prefix_cache=shared or args.prefix_cache,
+        prefix_cache=shared or args.prefix_cache, kernels=args.kernels,
     )
     if acct is not None and not drill:
         # a REAL preemption arrived mid-benchmark: the serve loop
@@ -503,6 +522,7 @@ def main(argv=None) -> int:
     lat = sorted(r.latency_s * 1e3 for r in sched.finished)
     out = {
         "concurrency": args.concurrency,
+        "kernels": args.kernels,
         "requests": args.requests,
         "finished": len(sched.finished),
         "tokens": sched.tokens_emitted

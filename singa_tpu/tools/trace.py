@@ -259,6 +259,20 @@ def summarize(records: list[dict]) -> dict:
         if r.get("kind") == "spec_accept" and isinstance(r.get("data"), dict)
     )
 
+    # kernel selection: the run-start kernel_select event says which
+    # attend implementation the serving engine ran (reference | fused)
+    # — incident reports must say which path a run took
+    attend_impl = next(
+        (
+            r["data"].get("impl")
+            for r in reversed(life)
+            if r.get("kind") == "kernel_select"
+            and isinstance(r.get("data"), dict)
+            and r["data"].get("site") == "serve.paged_attention"
+        ),
+        None,
+    )
+
     # prefix cache: per-admission prefix_hit events carry shared-block
     # and saved-prefill-chunk counts (serve/scheduler.py _admit_some)
     prefix_hit_events = [
@@ -342,6 +356,9 @@ def summarize(records: list[dict]) -> dict:
         # request-latency percentiles from per-request spans, decode
         # throughput from tick spans, lifecycle counts from events
         "serving": {
+            # which attend implementation served this run (the
+            # kernel_select run-start event; None = pre-kernels log)
+            "attend_impl": attend_impl,
             "request_latency_ms": {
                 "p50": round(_percentile(request_ms, 0.50), 2),
                 "p99": round(_percentile(request_ms, 0.99), 2),

@@ -85,6 +85,15 @@ SLOW_TESTS = {
     "test_bn_eval_uses_running_stats",
     "test_distort_jits",
     "test_trains_digits_to_reference_accuracy",
+    "test_fused_streams_identical_under_speculation",
+    "test_fused_verify_zero_draft_width_matches_reference",
+    "test_attend_stall_gate_smoke",
+    "test_fused_under_tensor_parallel_matches_single_device",
+    "test_fused_streams_identical_interleaved",
+    "test_fused_streams_identical_prefix_warm",
+    "test_serve_bench_kernels_fused_smoke",
+    "test_fused_jit_cache_pinned_one_program_per_shape",
+    "test_kernel_select_event_and_trace_attend_impl",
 }
 
 
