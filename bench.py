@@ -200,7 +200,34 @@ def _workload_result(name, trainer, slope, overhead, timed_steps,
         "comm_mode": trainer.comm_mode,
         "comm_dtype": trainer.comm_dtype,
         "comm_ms": comm_ms,
+        **_wire_fields(trainer),
         "method": "two-window slope fit (marginal per-step cost)",
+    }
+
+
+def _wire_fields(trainer, nominal_ndata: int = 8) -> dict:
+    """The int8-on-the-wire ring's deterministic numbers ({} unless the
+    row runs `kernels { grad_allreduce: quantized_ring }`): modeled
+    per-device data-axis bytes per step, reference fp32 collective over
+    the quantized ring — tools/collective_stall.py's gated arm. The
+    bench host's own data axis may be 1-wide (an empty wire), so the
+    model is priced at a nominal `wire_ndata`-wide axis (halved by
+    `wire_bytes_model` until the chunking actually divides — the
+    reported `wire_ndata` is the validated width); the RATIO is what
+    the row pins, and it is width-stable (both costs scale with
+    (n-1)/n)."""
+    comm = getattr(trainer, "_comm", None)
+    if comm is None or not comm.ring:
+        return {}
+    model = trainer.wire_bytes_model(
+        ndata=max(nominal_ndata, trainer._ring_ndata())
+    )
+    ref, ring = model["reference"], model["quantized_ring"]
+    return {
+        "wire_ndata": model["ndata"],
+        "wire_ref_bytes": ref,
+        "wire_ring_bytes": ring,
+        "wire_bytes_ratio": round(ref / ring, 3) if ring else None,
     }
 
 
@@ -393,6 +420,26 @@ def bench_lm_d128_q8(n1=256, n2=1280):
     return bench_tinylm(
         n1, n2, name="lm_d128_q8", conf="tinylm_d128.conf",
         grad_comm="q8", comm_buckets=4,
+    )
+
+
+def bench_lm_d128_q8wire(n1=256, n2=1280):
+    """`lm_d128_q8` with `kernels { grad_allreduce: quantized_ring }` —
+    the same quantized numerics, but the data-axis reduction is the
+    explicit int8-on-the-wire ppermute ring
+    (ops/quantized_collective.py) instead of the quantize-around-the-
+    psum reference seam. `wire_bytes_ratio` is the deterministic number
+    the row exists to pin — modeled per-device data-axis bytes,
+    reference fp32 collective over the ring's ppermute payloads (~3.9x
+    at int8; a regression in the chunking, the scale plumbing, or the
+    allgather skip moves it). On this CPU host the ring is a per-shard
+    shard_map emulation, so `value` (tokens/sec) trails `lm_d128_q8` by
+    construction — the bytes model and ring-vs-reference parity are
+    what regress-guard here, exactly collective_stall's or-gate in
+    CI."""
+    return bench_tinylm(
+        n1, n2, name="lm_d128_q8wire", conf="tinylm_d128.conf",
+        grad_comm="q8wire", comm_buckets=4,
     )
 
 
@@ -659,6 +706,7 @@ BENCHES = (
     ("lm_32k_d128", bench_lm_32k_d128),
     ("lm_d128_zero", bench_lm_d128_zero),
     ("lm_d128_q8", bench_lm_d128_q8),
+    ("lm_d128_q8wire", bench_lm_d128_q8wire),
     ("lm_d128_serve", bench_lm_d128_serve),
     ("lm_d128_spec", bench_lm_d128_spec),
     ("lm_d128_prefix", bench_lm_d128_prefix),

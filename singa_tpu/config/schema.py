@@ -775,11 +775,13 @@ class ServingConfig(Message):
 
 
 KERNEL_IMPLS = ("reference", "fused")
+GRAD_ALLREDUCE_IMPLS = ("reference", "quantized_ring")
 
 
 class KernelsConfig(Message):
     """singa-tpu extension: per-site kernel implementation selection
-    (the Pallas hot-path seam, singa_tpu/ops/paged_attention.py).
+    (the Pallas hot-path seam, singa_tpu/ops/paged_attention.py +
+    singa_tpu/ops/quantized_collective.py).
 
     ``paged_attention: fused`` swaps the serving engine's attention —
     every decode tick, prefill chunk, and speculative verify pass —
@@ -791,19 +793,40 @@ class KernelsConfig(Message):
     reorders the reduction); greedy token streams are identical.
     ``reference`` (default, = no block) keeps the bitwise-pinned
     oracle path untouched. ``interpret`` (default true) runs the
-    kernel through the Pallas interpreter — plain XLA ops, CPU-safe
-    and GSPMD-shardable, what CI exercises — set false on a real TPU
+    kernel through the Pallas interpreter (or, for the ring, the
+    pure-ppermute XLA form) — plain XLA ops, CPU-safe and
+    GSPMD-shardable, what CI exercises — set false on a real TPU
     to compile through Mosaic, which constrains the geometry
     (kv_block_len a multiple of 8, head_dim a multiple of 128; the
     engine rejects violations at construction, netlint KRN001 flags
-    them statically)."""
+    them statically).
+
+    ``grad_allreduce: quantized_ring`` swaps the trainer's data-axis
+    gradient collective — PR 8's ``grad_comm { mode: quantized }``
+    numerics, whose cast sits AROUND the GSPMD psum so the wire stays
+    fp32 — onto an explicit ring reduce-scatter + allgather whose
+    ppermute'd wire value is genuinely int8 (per-bucket f32 scale
+    riding alongside; ops/quantized_collective.py). Requires an active
+    quantized ``grad_comm`` block, composes with ``zero_update`` (the
+    ring's scatter output IS the update layout — the allgather phase
+    is skipped) and ``error_feedback``; the replica engine rejects it
+    (netlint KRN002 flags both statically, plus un-chunkable data-axis
+    geometry). ``reference`` keeps the dequantize-then-psum oracle —
+    jaxpr-identical to a config with no knob."""
 
     FIELDS = {
         # serving-tier attention: "reference" gather + cache_attend
         # oracle, "fused" Pallas paged-attention kernel
         "paged_attention": Field("enum", "reference", enum=KERNEL_IMPLS),
-        # run the fused kernel in the Pallas interpreter (CPU-safe);
-        # false = compile through Mosaic (TPU, geometry-gated)
+        # training-tier gradient collective: "reference" = grad_comm's
+        # quantize-around-the-psum oracle (fp32 on the wire),
+        # "quantized_ring" = int8-on-the-wire ppermute ring
+        "grad_allreduce": Field(
+            "enum", "reference", enum=GRAD_ALLREDUCE_IMPLS
+        ),
+        # run the fused kernel in the Pallas interpreter / the ring in
+        # its pure-XLA ppermute form (CPU-safe); false = compile the
+        # inner kernels through Mosaic (TPU, geometry-gated)
         "interpret": Field("bool", True),
     }
 

@@ -32,6 +32,7 @@ from .net_rules import (  # noqa: F401
     engine_rules,
     lint_cluster_text,
     lint_model_text,
+    ring_rules,
     sharding_rules_static,
 )
 from .shape_rules import shape_pass  # noqa: F401

@@ -77,6 +77,14 @@ KRN001 = rule(
     "fused paged_attention selected with a geometry the compiled "
     "kernel cannot tile",
 )
+KRN002 = rule(
+    "KRN002",
+    ERROR,
+    "quantized_ring grad_allreduce without a quantized grad_comm "
+    "block, with an un-chunkable data-axis geometry, with a >1-wide "
+    "non-data mesh axis, with a batch-stat (kBatchNorm) net, or with "
+    "the replica engine",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -497,13 +505,160 @@ def kernel_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
         )
 
 
+def ring_rules(
+    model_cfg: ModelConfig,
+    cluster_cfg: ClusterConfig | None,
+    widths: dict[str, int] | None,
+    path: str,
+    col: Collector,
+) -> None:
+    """KRN002 — static mirror of the quantized-ring rejections (the
+    trainer consults the SAME ``ring_reducible`` predicate and the same
+    quantized-block requirement at construction;
+    ops/quantized_collective.py). Seven arms, each reported
+    independently: (1) ``kernels { grad_allreduce: quantized_ring }``
+    without an active ``grad_comm { mode: quantized }`` block — the
+    ring is the quantized collective's wire implementation, there is
+    nothing to put on the wire; (2) combined with the replica (async
+    PS) engine, whose EASGD protocol owns its own sync math — the
+    CMM001 static mirror for this site, threaded through ``--cluster``;
+    (3) the CD engine — its layerwise step does not take the ring's
+    data-axis shard_map shape (``CDTrainer`` rejects at construction);
+    (4) a batch-stat (kBatchNorm) net — inside the ring's per-shard
+    backward, sync BN's GSPMD-psum'd global moments would silently
+    become local-shard stats; (5) a >1-wide non-data mesh axis — the
+    ring is flat over the data axis; hierarchical two-level rings are a
+    ROADMAP carry-over; (6) a train batchsize the data-axis width
+    cannot divide — each shard computes its own local partial; (7) a
+    data-axis width the ring's bucket chunking cannot divide — checked
+    on the statically-declared neuron dims (a layer's bias gradient is
+    ``(num_output,)``, chunked on dim 0; weight input dims need shape
+    inference and are left to the runtime predicate)."""
+    kern = getattr(model_cfg, "kernels", None)
+    if kern is None or kern.grad_allreduce != "quantized_ring":
+        return
+    gc = getattr(model_cfg, "grad_comm", None)
+    if gc is None or gc.mode != "quantized":
+        col.emit(
+            KRN002,
+            path,
+            "kernels.grad_allreduce 'quantized_ring' without an active "
+            "grad_comm { mode: quantized } block: the ring is the "
+            "quantized collective's wire implementation — the trainer "
+            "rejects this config at construction",
+            fix_hint="add grad_comm { mode: quantized dtype: int8 }, or "
+            "keep grad_allreduce: reference",
+        )
+    if (
+        cluster_cfg is not None
+        and cluster_cfg.nservers > 0
+        and not cluster_cfg.synchronous
+        and model_cfg.alg != "kContrastiveDivergence"
+        and model_cfg.updater is not None
+    ):
+        col.emit(
+            KRN002,
+            path,
+            "kernels.grad_allreduce 'quantized_ring' with an "
+            "asynchronous nservers>0 cluster: the replica engine's "
+            "EASGD protocol owns its own gradient sync and rejects the "
+            "ring at construction",
+            fix_hint="drop the kernels/grad_comm blocks, or run the "
+            "synchronous engine (synchronous: true / nservers: 0)",
+        )
+    if model_cfg.alg == "kContrastiveDivergence":
+        col.emit(
+            KRN002,
+            path,
+            "kernels.grad_allreduce 'quantized_ring' with the "
+            "kContrastiveDivergence engine: the CD trainer's layerwise "
+            "step does not take the ring's data-axis shard_map shape "
+            "and rejects it at construction",
+            fix_hint="keep grad_allreduce: reference for CD jobs",
+        )
+    bn = [
+        l.name
+        for l in (model_cfg.neuralnet.layer if model_cfg.neuralnet else [])
+        if l.type == "kBatchNorm"
+    ]
+    if bn:
+        col.emit(
+            KRN002,
+            path,
+            "kernels.grad_allreduce 'quantized_ring' with batch-stat "
+            f"layers {bn}: the ring's per-shard backward would turn "
+            "sync BatchNorm into local-shard BN (biased variance) — "
+            "the trainer rejects this config at construction",
+            fix_hint="drop the kBatchNorm layers, or keep "
+            "grad_allreduce: reference",
+        )
+    other = {
+        a: w
+        for a, w in (widths or {}).items()
+        if a != "data" and w > 1
+    }
+    if other:
+        col.emit(
+            KRN002,
+            path,
+            "kernels.grad_allreduce 'quantized_ring' runs over the "
+            f"data axis only, but the cluster also shards {other} — "
+            "hierarchical (intra/inter-slice) two-level rings are a "
+            "ROADMAP carry-over; the trainer rejects this config at "
+            "construction",
+            fix_hint="widen only the data axis, or keep "
+            "grad_allreduce: reference",
+        )
+    ndata = (widths or {}).get("data", 0)
+    net_cfg = model_cfg.neuralnet
+    if ndata <= 1 or net_cfg is None:
+        return
+    for l in net_cfg.layer:
+        dp = getattr(l, "data_param", None)
+        bs = getattr(dp, "batchsize", 0) if dp is not None else 0
+        if bs and "kTrain" not in (l.exclude or []) and bs % ndata:
+            col.emit(
+                KRN002,
+                path,
+                f"kernels.grad_allreduce 'quantized_ring' on a {ndata}"
+                f"-wide data axis, but layer {l.name!r}'s train "
+                f"batchsize {bs} is not divisible by it: each shard "
+                "computes its own local partial gradients — the "
+                "trainer rejects this config at construction",
+                fix_hint=f"pick a batchsize divisible by {ndata}, or "
+                "resize the data axis",
+            )
+    from ..ops.quantized_collective import ring_reducible
+
+    shapes = {}
+    for l in net_cfg.layer:
+        fields = _NEURON_DIM_FIELDS.get(l.type)
+        if fields:
+            sub = getattr(l, fields[0], None)
+            dim = getattr(sub, fields[1], None) if sub else None
+            if dim:
+                shapes[f"{l.name} ({fields[1]} {dim})"] = (dim,)
+    reason = ring_reducible(shapes, ndata)
+    if reason is not None:
+        col.emit(
+            KRN002,
+            path,
+            f"kernels.grad_allreduce 'quantized_ring' on a {ndata}-wide "
+            f"data axis, but {reason} — the trainer rejects this config "
+            "at construction",
+            fix_hint=f"pick neuron dims divisible by {ndata}, resize "
+            "the data axis, or keep grad_allreduce: reference",
+        )
+
+
 # ---------------------------------------------------------------------------
 # sharding rules (model conf x cluster axis widths)
 # ---------------------------------------------------------------------------
 
 #: config-declared neuron-dim per layer type, for the static SHD001
 #: fallback when the net can't be built (data sources absent). The
-#: build-based check in shape_rules covers every param precisely.
+#: build-based check in shape_rules covers every param precisely — and
+#: ring_rules reuses the table for KRN002's bias-gradient chunk check.
 _NEURON_DIM_FIELDS = {
     "kInnerProduct": ("inner_product_param", "num_output"),
     "kDense": ("dense_param", "num_output"),

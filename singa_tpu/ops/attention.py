@@ -123,6 +123,17 @@ try:  # pallas import kept soft: CPU-only environments use interpret mode
 except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
+if HAS_PALLAS:
+    #: jax 0.4.x spells the HBM/unpinned memory space ANY; newer jax,
+    #: HBM (or the MemorySpace enum). Chained getattrs never raise, so
+    #: an unknown spelling degrades to BlockSpec's default memory space
+    #: instead of silently disabling pallas entirely.
+    _HBM = (
+        getattr(pltpu, "HBM", None)
+        or getattr(pltpu, "ANY", None)
+        or getattr(getattr(pltpu, "MemorySpace", None), "ANY", None)
+    )
+
 
 def _causal_nlive(q_offset, bq, block_k):
     """Number of K blocks at or below a q block's diagonal — the causal
@@ -580,8 +591,8 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         grid=(bh, s // block_q),
         in_specs=[
             qblk,
-            pl.BlockSpec(memory_space=pltpu.HBM),  # K^T stays in HBM
-            pl.BlockSpec(memory_space=pltpu.HBM),  # V^T stays in HBM
+            pl.BlockSpec(memory_space=_HBM),  # K^T stays in HBM
+            pl.BlockSpec(memory_space=_HBM),  # V^T stays in HBM
         ],
         out_specs=[qblk, lse_blk],
         out_shape=out_shape,
@@ -624,7 +635,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     )[:, None, :]
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
-    hbm = pl.BlockSpec(memory_space=pltpu.HBM)
+    hbm = pl.BlockSpec(memory_space=_HBM)
     lse_blk = pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j))
     if _variant(s, d, k.dtype) == "staged":
         args = (flat(q), flat(k), flat(v), flat(g), lse, delta)

@@ -1,0 +1,537 @@
+"""True int8-on-the-wire gradient collectives: a quantized ring reduce.
+
+PR 8's ``grad_comm`` block models quantized gradient reduction — each
+bucket is cast to a scaled int8/bf16 wire value *around* the data-axis
+reduction — but the documented carry-over stands: the cast sits on the
+logical (already-summed) gradient, so XLA's implicit GSPMD ``psum`` /
+reduce-scatter still moves full-precision bytes. The wire is not
+actually 4x narrower. EQuARX (PAPERS.md, arxiv 2506.17615) shows the
+win comes from keeping the *reduction itself* in the quantized domain.
+
+This module is that reduction: a ring reduce-scatter + allgather over
+the data axis whose wire value is genuinely int8. It runs per shard
+under ``shard_map`` (``parallel/ring.py``'s ppermute ring is the
+structural precedent), so each shard holds its own LOCAL partial
+gradient — the thing GSPMD never exposes — and every hop
+``lax.ppermute``s a *quantized* chunk, with the bucket's f32 scale
+riding alongside as a tiny scalar operand:
+
+  reduce-scatter   each param's gradient is chunked over the data axis
+                   (``chunk_dims``); at hop t every shard quantizes its
+                   accumulated chunk (one symmetric max-abs scale per
+                   BUCKET — the grad_comm scale granularity), ppermutes
+                   the int8 bytes + the scale one hop, dequantizes what
+                   arrives, and accumulates its own local partial of
+                   that chunk in f32 — the EQuARX two-level
+                   construction: narrow on the wire, full precision in
+                   the accumulator.
+  allgather        after N-1 hops each shard owns its chunk's full sum;
+                   the owner quantizes it ONCE (banking the
+                   quantization error as the error-feedback residual)
+                   and the (q, scale) pair rides N-1 more hops around
+                   the ring — every shard dequantizes the identical
+                   bytes, so the gathered gradient is bitwise identical
+                   on every shard. Under ``zero_update`` this phase is
+                   skipped: the ring's natural scatter output IS the
+                   update layout (each shard keeps exactly its
+                   shard-local chunk).
+
+Error feedback (the one-shot-EF caveat): PR 8's reference path banks
+the ENTIRE compression error — quantization there is one shot on the
+summed gradient. The ring re-quantizes per hop, and a hop's rounding
+error is only known to the shard that rounded, for a chunk it does not
+own — so the residual banks the final (owner-side) quantization error
+exactly, in full f32, while per-hop wire errors go un-fed-back. They
+are bounded by the same 1/127 relative scale and convergence stays
+within the CI parity bar (tools/convergence.py ``--grad_comm q8wire``);
+the trade is documented in README "Kernels".
+
+NaN-poisoned-scale semantics are preserved: a NaN/Inf partial drives
+its bucket's max-abs scale to NaN, dequantization multiplies by the
+scale, and the poison propagates through every downstream accumulation
+— the divergence guard's verdict over the reduced grads fires on the
+same step as fp32.
+
+The pure-ppermute form here is plain XLA ops — the interpret/CPU-CI
+path that every test run exercises. ``fused_hop`` swaps the per-hop
+dequantize+accumulate onto a small Pallas kernel for real hardware
+(``quant_acc``), gated by the same ``fusable``-style geometry predicate
+pattern as the paged-attention kernel (``ring_fusable``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.6 exports shard_map at the top level; this image's
+    # 0.4.x ships it under experimental — parallel/{ring,moe,pipeline}
+    # import this shim too, so every shard_map call site resolves the
+    # rename in one place
+    from jax.experimental.shard_map import shard_map
+except Exception:  # pragma: no cover - newer jax
+    shard_map = jax.shard_map
+
+#: int8 symmetric range: q in [-127, 127], scale = max|e| / 127 (shared
+#: with parallel/collectives.py's reference quantized path — ONE
+#: quantize/dequantize pair, so the ring and the oracle cannot drift)
+INT8_MAX = 127.0
+
+#: scale floor: an all-zero bucket must not divide by zero
+_SCALE_FLOOR = 1e-30
+
+#: hardware tile floor for the compiled (fused_hop) inner kernel: the
+#: per-hop chunk is processed as (rows, 128) f32 tiles — sublanes of 8,
+#: lanes of 128, like ops/paged_attention's floor
+_SUBLANE, _LANE = 8, 128
+
+
+# ---------------------------------------------------------------------------
+# shared quantize/dequantize helpers (the one pair both the reference
+# grad_comm path and the ring consult)
+# ---------------------------------------------------------------------------
+
+
+def symmetric_scale(arrays) -> jnp.ndarray:
+    """One symmetric int8 scale for a bucket: max-abs over every array
+    in it, floored away from zero so an all-zero bucket cannot divide
+    by zero. Max is exactly associative, so the scale is
+    bitwise-independent of layout — and a NaN/Inf element poisons it
+    (``jnp.max`` propagates NaN), which is the guard contract: the
+    poison survives dequantization."""
+    amax = functools.reduce(
+        jnp.maximum,
+        (jnp.max(jnp.abs(a.astype(jnp.float32))) for a in arrays),
+    )
+    return jnp.maximum(amax, jnp.float32(_SCALE_FLOOR)) / INT8_MAX
+
+
+def quantize_int8(e: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric int8 cast: round(e / scale), clipped to [-127, 127].
+    A NaN scale produces implementation-defined int8 bytes — harmless,
+    because ``dequantize_int8`` multiplies by the same NaN scale."""
+    return jnp.clip(
+        jnp.round(e.astype(jnp.float32) / scale), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """int8 wire value back to f32: q * scale (NaN scale -> NaN out)."""
+    return q.astype(jnp.float32) * scale
+
+
+def wire_cast(e: jnp.ndarray, scale, dtype: str):
+    """Cast ``e`` to the wire dtype: (wire array, scale or None)."""
+    if dtype == "int8":
+        return quantize_int8(e, scale), scale
+    return e.astype(jnp.bfloat16), None
+
+
+def wire_uncast(w: jnp.ndarray, scale, dtype: str) -> jnp.ndarray:
+    if dtype == "int8":
+        return dequantize_int8(w, scale)
+    return w.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# geometry predicates (consulted by the trainer's runtime rejection AND
+# netlint's KRN002 — a static mirror must never drift from its runtime)
+# ---------------------------------------------------------------------------
+
+
+def ring_reducible(
+    shapes: dict, ndata: int, chunk_dims: dict | None = None
+) -> str | None:
+    """None if the ring can chunk every gradient over an ``ndata``-wide
+    data axis, else the reason it cannot. ``shapes`` maps param name ->
+    stored shape; ``chunk_dims`` maps name -> the dim the ring chunks
+    (default 0 — the update-layout dim under ``zero_update``). The ring
+    sends fixed equal chunks, so the chunk dim must divide evenly: a
+    padded phantom chunk would ppermute garbage into real sums."""
+    if ndata <= 1:
+        return None
+    for name in sorted(shapes):
+        shape = tuple(shapes[name])
+        if not shape:
+            return (
+                f"param {name!r} is a scalar: the ring cannot chunk a "
+                "0-d gradient over the data axis"
+            )
+        d = (chunk_dims or {}).get(name, 0)
+        if shape[d] % ndata:
+            return (
+                f"param {name!r} dim {d} ({shape[d]}) not divisible by "
+                f"the data-axis width {ndata}: the ring's bucket "
+                "chunking cannot split it into equal wire chunks"
+            )
+    return None
+
+
+def ring_fusable(
+    shapes: dict, ndata: int, chunk_dims: dict | None = None,
+    interpret: bool = True,
+) -> str | None:
+    """None if the fused (Pallas) per-hop quantize+accumulate kernel can
+    serve this geometry, else the reason. The interpret form tiles
+    anything (plain XLA ops); the compiled form processes each chunk as
+    (rows, 128) f32 register tiles, so the per-shard chunk element
+    count must align to the (8, 128) tile."""
+    reason = ring_reducible(shapes, ndata, chunk_dims)
+    if reason is not None:
+        return reason
+    if interpret or ndata <= 0:
+        return None
+    tile = _SUBLANE * _LANE
+    for name in sorted(shapes):
+        shape = tuple(shapes[name])
+        d = (chunk_dims or {}).get(name, 0)
+        elems = shape[d] // max(1, ndata)
+        for i, s in enumerate(shape):
+            if i != d:
+                elems *= s
+        if elems % tile:
+            return (
+                f"param {name!r} ring chunk has {elems} elements, not a "
+                f"multiple of the ({_SUBLANE}, {_LANE}) f32 tile: the "
+                "compiled quantize+accumulate kernel cannot tile it"
+            )
+    return None
+
+
+# ---------------------------------------------------------------------------
+# optional Pallas inner kernel: dequantize + accumulate fused per hop
+# ---------------------------------------------------------------------------
+
+
+def _quant_acc_kernel(q_ref, s_ref, x_ref, o_ref):
+    o_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[0, 0] + x_ref[...]
+
+
+def quant_acc(
+    q: jnp.ndarray, scale: jnp.ndarray, local: jnp.ndarray,
+    *, interpret: bool = True,
+) -> jnp.ndarray:
+    """``dequantize_int8(q, scale) + local`` as ONE fused Pallas kernel
+    — the per-hop accumulation's memory traffic is one read of the int8
+    chunk, one read of the local f32 partial, one write, with no f32
+    dequantized intermediate ever hitting HBM. ``interpret=True`` runs
+    it through the Pallas interpreter (plain XLA ops — the unit test
+    pins it to the jnp form within 1 ulp; the interpreter may contract
+    the multiply-add into an fma); ``interpret=False`` compiles
+    through Mosaic and needs ``ring_fusable`` geometry."""
+    from jax.experimental import pallas as pl
+
+    n = local.size
+    cols = _LANE if n % _LANE == 0 else n
+    qf = q.reshape(n // cols, cols)
+    xf = local.astype(jnp.float32).reshape(n // cols, cols)
+    out = pl.pallas_call(
+        _quant_acc_kernel,
+        out_shape=jax.ShapeDtypeStruct(xf.shape, jnp.float32),
+        interpret=bool(interpret),
+    )(qf, scale.reshape(1, 1), xf)
+    return out.reshape(local.shape)
+
+
+# ---------------------------------------------------------------------------
+# the ring itself (runs per shard, inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _chunked(x: jnp.ndarray, d: int, n: int) -> jnp.ndarray:
+    """(..., S[d], ...) -> (n, S[d]//n, ...rest) with the chunk dim
+    moved to the front."""
+    y = jnp.moveaxis(x, d, 0)
+    return y.reshape((n, y.shape[0] // n) + y.shape[1:])
+
+
+def _unchunk(y: jnp.ndarray, d: int, shape) -> jnp.ndarray:
+    """Inverse of ``_chunked``: (n, c, ...rest) -> the original shape."""
+    z = y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
+    return jnp.moveaxis(z, 0, d).reshape(shape)
+
+
+def _shard_shape(shape, d: int, n: int):
+    return tuple(
+        s // n if i == d else s for i, s in enumerate(shape)
+    )
+
+
+def ring_reduce_gradients(
+    grads: dict,
+    residuals: dict,
+    buckets: tuple,
+    *,
+    axis_name: str,
+    nshards: int,
+    chunk_dims: dict,
+    gather: dict,
+    dtype: str = "int8",
+    error_feedback: bool = True,
+    overlapped: bool = False,
+    residual_key=None,
+    fused_hop: bool = False,
+    fused_interpret: bool = True,
+) -> tuple[dict, dict]:
+    """The quantized ring all-reduce, per shard: -> (reduced grads,
+    new error-feedback residual chunks).
+
+    Runs INSIDE ``shard_map`` over the data axis. ``grads`` are this
+    shard's local partials, pre-scaled so the cross-shard sum is the
+    desired reduction (the trainer divides its local-batch mean grads
+    by ``nshards``). ``residuals`` hold this shard's OWN chunk of each
+    param's error-feedback residual (sliced by the shard_map in_specs).
+    ``buckets`` are the reverse-topo groups from
+    ``parallel.collectives.reverse_topo_buckets`` — one wire scale per
+    bucket per hop, and with ``overlapped`` the buckets chain through
+    ``optimization_barrier`` in gradient-readiness order exactly like
+    the reference path. ``gather[name]`` False keeps the scatter layout
+    (zero_update: the shard's chunk IS its update shard; the allgather
+    phase never runs for that param).
+
+    Output identity: gathered params are reconstructed from the SAME
+    (int8 bytes, f32 scale) pairs on every shard, so the reduced
+    gradient is bitwise identical ring-wide — tested, and what lets the
+    step's out_specs declare them replicated.
+    """
+    me = jax.lax.axis_index(axis_name)
+    n = nshards
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    out: dict = {}
+    new_res: dict = {}
+    token = None
+
+    for bucket in buckets:
+        gs = {nm: grads[nm] for nm in bucket}
+        if token is not None:
+            # pin this bucket's ring after the previous bucket's first
+            # reduced array: the same reverse-topo issue-order chain as
+            # the reference path (optimization_barrier is a value
+            # identity that adds a scheduling edge)
+            names = list(gs)
+            fused = jax.lax.optimization_barrier(
+                tuple(gs[nm] for nm in names) + (token,)
+            )
+            gs = dict(zip(names, fused[:-1]))
+        chunks = {
+            nm: _chunked(g, chunk_dims[nm], n) for nm, g in gs.items()
+        }
+
+        def pick(idx):
+            return {
+                nm: jax.lax.dynamic_index_in_dim(
+                    c, idx % n, axis=0, keepdims=False
+                )
+                for nm, c in chunks.items()
+            }
+
+        # --- reduce-scatter: after n-1 hops shard ``me`` holds the
+        # full sum of its own chunk ``me`` (start chunk me-1; the chunk
+        # arriving at hop t is me-t-2, accumulated in f32) ---
+        acc = pick(me - 1)
+
+        def hop(carry, t):
+            acc = carry
+            scale = (
+                symmetric_scale(acc.values()) if dtype == "int8" else None
+            )
+            wires = {
+                nm: wire_cast(a, scale, dtype)[0] for nm, a in acc.items()
+            }
+            wires = {
+                nm: jax.lax.ppermute(w, axis_name, perm)
+                for nm, w in wires.items()
+            }
+            if scale is not None:
+                scale = jax.lax.ppermute(scale, axis_name, perm)
+            local = pick(me - t - 2)
+            nxt = {}
+            for nm, w in wires.items():
+                if fused_hop and dtype == "int8":
+                    nxt[nm] = quant_acc(
+                        w, scale, local[nm], interpret=fused_interpret
+                    )
+                else:
+                    nxt[nm] = wire_uncast(w, scale, dtype) + local[nm]
+            return nxt, None
+
+        if n > 1:
+            acc, _ = jax.lax.scan(hop, acc, jnp.arange(n - 1))
+
+        # --- error-feedback injection + the one owner-side quantize:
+        # the owner adds its residual chunk in full f32, quantizes the
+        # finished sum once for the broadcast, and banks the exact
+        # quantization error as the next step's residual (per-hop wire
+        # errors above are the documented un-fed-back caveat) ---
+        if error_feedback and residual_key is not None:
+            # the residual arrives as the shard's slice in ORIGINAL dim
+            # order (the shard_map in_specs slice dim chunk_dims[nm]);
+            # acc is in chunk-front layout, so move the chunk dim up
+            # before adding (identity when the chunk dim is 0)
+            acc = {
+                nm: a + jnp.moveaxis(
+                    residuals[residual_key(nm)].astype(jnp.float32),
+                    chunk_dims[nm], 0,
+                )
+                for nm, a in acc.items()
+            }
+        fscale = symmetric_scale(acc.values()) if dtype == "int8" else None
+        fq = {nm: wire_cast(a, fscale, dtype)[0] for nm, a in acc.items()}
+        deq = {nm: wire_uncast(w, fscale, dtype) for nm, w in fq.items()}
+        if error_feedback and residual_key is not None:
+            for nm in bucket:
+                # bank the owner-side quantization error back in the
+                # residual's original dim order (the out_specs layout)
+                new_res[residual_key(nm)] = jnp.moveaxis(
+                    acc[nm] - deq[nm], 0, chunk_dims[nm]
+                )
+
+        # --- allgather: the (int8 bytes, scale) pair rides n-1 more
+        # hops; chunk c lands dequantized from identical bytes on every
+        # shard, so the gathered value is bitwise ring-invariant.
+        # zero_update params skip this: their scatter chunk IS the
+        # update-layout shard ---
+        gathered = [nm for nm in bucket if gather[nm]]
+        if gathered and n > 1:
+            buf = {
+                nm: jax.lax.dynamic_update_index_in_dim(
+                    jnp.zeros_like(chunks[nm], dtype=jnp.float32),
+                    deq[nm], me, axis=0,
+                )
+                for nm in gathered
+            }
+
+            def ghop(carry, t):
+                buf, fq, fscale = carry
+                fq = {
+                    nm: jax.lax.ppermute(w, axis_name, perm)
+                    for nm, w in fq.items()
+                }
+                if fscale is not None:
+                    fscale = jax.lax.ppermute(fscale, axis_name, perm)
+                idx = (me - t - 1) % n
+                buf = {
+                    nm: jax.lax.dynamic_update_index_in_dim(
+                        b, wire_uncast(fq[nm], fscale, dtype), idx, axis=0
+                    )
+                    for nm, b in buf.items()
+                }
+                return (buf, fq, fscale), None
+
+            (buf, _, _), _ = jax.lax.scan(
+                ghop,
+                (buf, {nm: fq[nm] for nm in gathered}, fscale),
+                jnp.arange(n - 1),
+            )
+            for nm in gathered:
+                out[nm] = _unchunk(
+                    buf[nm], chunk_dims[nm], gs[nm].shape
+                ).astype(gs[nm].dtype)
+        else:
+            for nm in gathered:  # n == 1: the chunk is the whole array
+                out[nm] = _unchunk(
+                    deq[nm][None], chunk_dims[nm], gs[nm].shape
+                ).astype(gs[nm].dtype)
+        for nm in bucket:
+            if not gather[nm]:
+                d = chunk_dims[nm]
+                out[nm] = jnp.moveaxis(
+                    deq[nm], 0, d
+                ).reshape(
+                    _shard_shape(gs[nm].shape, d, n)
+                ).astype(gs[nm].dtype)
+        if overlapped:
+            token = out[bucket[0]]
+    return out, new_res
+
+
+# ---------------------------------------------------------------------------
+# wire-bytes accounting (the deterministic arm of the stall gate)
+# ---------------------------------------------------------------------------
+
+
+def _wire_itemsize(dtype: str) -> int:
+    return 1 if dtype == "int8" else 2
+
+
+def modeled_wire_bytes(
+    sizes: dict, buckets: tuple, ndata: int, *,
+    dtype: str = "int8", gather: dict | None = None,
+) -> int:
+    """Per-device bytes the quantized ring moves across the data axis
+    in one step — what each hop's ppermute operands add up to: the
+    reduce phase sends n-1 (chunk + scale) payloads per bucket, the
+    allgather n-1 more for gathered params (skipped under zero_update's
+    scatter layout). ``sizes`` maps param name -> element count;
+    ``tests`` pin this model against the step jaxpr's actual ppermute
+    operand bytes (``ppermute_wire_bytes``), so the gated number cannot
+    drift from what the program sends."""
+    if ndata <= 1:
+        return 0
+    w = _wire_itemsize(dtype)
+    scale_bytes = 4 if dtype == "int8" else 0
+    total = 0
+    for bucket in buckets:
+        chunk = sum(sizes[nm] // ndata for nm in bucket)
+        total += (ndata - 1) * (chunk * w + scale_bytes)  # reduce phase
+        gchunk = sum(
+            sizes[nm] // ndata
+            for nm in bucket
+            if gather is None or gather[nm]
+        )
+        if gchunk:
+            total += (ndata - 1) * (gchunk * w + scale_bytes)  # allgather
+    return total
+
+
+def reference_wire_bytes(
+    sizes: dict, ndata: int, *, scatter_only: bool = False
+) -> int:
+    """Per-device bytes the REFERENCE path's fp32 data-axis collective
+    moves per step: a bandwidth-optimal ring all-reduce of E elements
+    costs each device 2(n-1)/n * 4E bytes (reduce-scatter + allgather);
+    under zero_update the allgather half moves to the param constraint
+    and the grad collective is the reduce-scatter alone. This is the
+    wire PR 8's quantize-around-the-psum could not shrink — the
+    comparison baseline for ``wire_bytes_ratio``."""
+    if ndata <= 1:
+        return 0
+    total_elems = sum(sizes.values())
+    phases = 1 if scatter_only else 2
+    return int(phases * (ndata - 1) * total_elems * 4 / ndata)
+
+
+def ppermute_wire_bytes(jaxpr) -> int:
+    """Sum the per-device bytes every ``ppermute`` in ``jaxpr`` moves,
+    recursing into scans (multiplied by trip count), conds, and other
+    sub-jaxprs — the measured half of the wire-bytes gate: counted from
+    the program the step actually traces, not from the model. Accepts a
+    ClosedJaxpr (``jax.make_jaxpr(...)(...)``) or a raw Jaxpr."""
+    import jax.core as jcore
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+
+    def walk(jx, mult: int) -> int:
+        total = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "ppermute":
+                for v in eqn.invars:
+                    aval = v.aval
+                    total += (
+                        mult * int(aval.size) * jnp.dtype(aval.dtype).itemsize
+                    )
+            submult = mult
+            if eqn.primitive.name == "scan":
+                submult = mult * int(eqn.params.get("length", 1))
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (list, tuple)) else (val,)
+                for v in vals:
+                    if isinstance(v, jcore.ClosedJaxpr):
+                        total += walk(v.jaxpr, submult)
+                    elif isinstance(v, jcore.Jaxpr):
+                        total += walk(v, submult)
+        return total
+
+    return walk(inner, 1)

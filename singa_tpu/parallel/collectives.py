@@ -54,13 +54,16 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..ops.quantized_collective import (
+    dequantize_int8,
+    quantize_int8,
+    symmetric_scale,
+)
+
 #: buffer-pytree namespace for the error-feedback residuals (dunder
 #: prefix like the guard counters — never collides with layer buffers,
 #: which are namespaced by layer name)
 RESIDUAL_PREFIX = "__gradres__/"
-
-#: int8 symmetric range: q in [-127, 127], scale = max|e| / 127
-_INT8_MAX = 127.0
 
 
 def residual_key(name: str) -> str:
@@ -74,12 +77,21 @@ def is_residual_key(key: str) -> bool:
 
 @dataclasses.dataclass(frozen=True)
 class GradCommSpec:
-    """The trainer-facing slice of the ``grad_comm`` config block."""
+    """The trainer-facing slice of the ``grad_comm`` config block (plus
+    the ``kernels { grad_allreduce }`` wire-implementation knob)."""
 
     mode: str = "exact"  # "exact" | "quantized"
     dtype: str = "int8"  # wire dtype for quantized mode: "int8" | "bf16"
     error_feedback: bool = True
     buckets: int = 0  # 0/1 = per-param granularity, no ordering chain
+    #: how the quantized reduction crosses the data axis: "reference"
+    #: (grad_comm's cast around the GSPMD psum — fp32 on the wire, the
+    #: bitwise-pinned oracle) or "quantized_ring" (the explicit
+    #: int8-on-the-wire ppermute ring, ops/quantized_collective.py)
+    wire_impl: str = "reference"
+    #: pure-XLA ppermute form (True, the CPU-CI path) vs the fused
+    #: Pallas per-hop quantize+accumulate kernel (False, real hardware)
+    interpret: bool = True
 
     @property
     def quantized(self) -> bool:
@@ -90,17 +102,45 @@ class GradCommSpec:
         return self.buckets > 1
 
     @property
+    def ring(self) -> bool:
+        """Whether the data-axis reduction is the explicit quantized
+        ring (int8 bytes in the ppermutes) rather than the reference
+        dequantize-then-psum seam."""
+        return self.wire_impl == "quantized_ring"
+
+    @property
     def wants_residuals(self) -> bool:
         """Whether the step carries error-feedback residual buffers."""
         return self.quantized and self.error_feedback
 
     @staticmethod
-    def from_config(cfg) -> "GradCommSpec | None":
+    def from_config(cfg, kernels=None) -> "GradCommSpec | None":
         """-> GradCommSpec, or None when the block is absent OR
         structurally inert (mode exact, no bucketization). Returning
         None for an inert block is the bitwise-exactness guarantee:
         ``grad_comm { mode: exact }`` must trace the identical program
-        a config with no block traces."""
+        a config with no block traces — and ``kernels { grad_allreduce:
+        reference }`` (the default) changes nothing about it.
+
+        ``kernels`` is the model conf's ``kernels {}`` block;
+        ``grad_allreduce: quantized_ring`` requires an active quantized
+        ``grad_comm`` block (the ring IS the quantized collective's
+        wire implementation — with nothing quantized there is no wire
+        value to narrow) and raises ConfigError without one."""
+        impl = (
+            kernels.grad_allreduce if kernels is not None else "reference"
+        )
+        interpret = bool(kernels.interpret) if kernels is not None else True
+        if impl == "quantized_ring" and (
+            cfg is None or cfg.mode != "quantized"
+        ):
+            from ..config.schema import ConfigError
+
+            raise ConfigError(
+                "kernels { grad_allreduce: quantized_ring } needs an "
+                "active grad_comm { mode: quantized } block: the ring is "
+                "the quantized collective's wire implementation"
+            )
         if cfg is None:
             return None
         spec = GradCommSpec(
@@ -108,6 +148,8 @@ class GradCommSpec:
             dtype=cfg.dtype,
             error_feedback=bool(cfg.error_feedback),
             buckets=max(0, int(cfg.buckets)),
+            wire_impl=impl,
+            interpret=interpret,
         )
         if not spec.quantized and not spec.overlapped:
             return None
@@ -117,23 +159,30 @@ class GradCommSpec:
 def apply_grad_comm_tag(cfg, tag: str):
     """CLI shorthand -> ``cfg.grad_comm`` (sweep / convergence / bench):
     ``q8`` = quantized int8 + error feedback, ``bf16`` = quantized bf16,
-    ``exact`` = an explicit (inert) exact block, "" = leave untouched."""
+    ``q8wire`` = q8 with the int8-on-the-wire ring collective
+    (``kernels { grad_allreduce: quantized_ring }``), ``exact`` = an
+    explicit (inert) exact block, "" = leave untouched."""
     if not tag:
         return cfg
-    from ..config.schema import GradCommConfig
+    from ..config.schema import GradCommConfig, KernelsConfig
 
     gc = GradCommConfig()
     if tag == "exact":
         gc.mode = "exact"
-    elif tag == "q8":
+    elif tag in ("q8", "q8wire"):
         gc.mode, gc.dtype = "quantized", "int8"
     elif tag == "bf16":
         gc.mode, gc.dtype = "quantized", "bf16"
     else:
         raise ValueError(
-            f"unknown grad_comm tag {tag!r} (choose exact, q8, bf16)"
+            f"unknown grad_comm tag {tag!r} (choose exact, q8, q8wire, "
+            "bf16)"
         )
     cfg.grad_comm = gc
+    if tag == "q8wire":
+        kern = cfg.kernels if cfg.kernels is not None else KernelsConfig()
+        kern.grad_allreduce = "quantized_ring"
+        cfg.kernels = kern
     return cfg
 
 
@@ -213,15 +262,13 @@ def _chain(gs: dict, token):
 
 
 def _bucket_scale(es: dict) -> jnp.ndarray:
-    """One symmetric int8 scale for the bucket: max-abs over every
-    gradient in it, floored away from zero so an all-zero bucket cannot
-    divide by zero (max is exactly associative, so the scale is
-    bitwise-independent of layout)."""
-    amax = functools.reduce(
-        jnp.maximum,
-        (jnp.max(jnp.abs(e.astype(jnp.float32))) for e in es.values()),
-    )
-    return jnp.maximum(amax, jnp.float32(1e-30)) / _INT8_MAX
+    """One symmetric int8 scale for the bucket — the shared
+    ``symmetric_scale`` helper (ops/quantized_collective.py), so the
+    reference path and the quantized ring consult ONE formula: max-abs
+    over every gradient, floored away from zero (max is exactly
+    associative, so the scale is bitwise-independent of layout; NaN/Inf
+    gradients poison it, the guard contract)."""
+    return symmetric_scale(es.values())
 
 
 def reduce_gradients(
@@ -268,13 +315,9 @@ def reduce_gradients(
             scale = _bucket_scale(es) if spec.dtype == "int8" else None
             for n, e in es.items():
                 if spec.dtype == "int8":
-                    q = jnp.clip(
-                        jnp.round(e.astype(jnp.float32) / scale),
-                        -_INT8_MAX,
-                        _INT8_MAX,
-                    ).astype(jnp.int8)
-                    ghat = (
-                        constrain(n, q).astype(jnp.float32) * scale
+                    q = quantize_int8(e, scale)
+                    ghat = dequantize_int8(
+                        constrain(n, q), scale
                     ).astype(e.dtype)
                 else:  # bf16
                     ghat = constrain(

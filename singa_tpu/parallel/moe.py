@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.quantized_collective import shard_map
 from .mesh import axis_pair_mesh
 
 EXPERT_AXIS = "expert"
@@ -136,7 +137,7 @@ def moe_ffn(
         # shape (1,) so the data axis can stack shards' values
         return y.reshape(b, s, d).astype(x.dtype), aux.reshape(1)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(
@@ -235,7 +236,7 @@ def moe_ffn_a2a(
         return y.reshape(b, s, d).astype(x.dtype), aux.reshape(1)
 
     token_spec = P(token_axes if data else axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(

@@ -12,8 +12,8 @@ reference length and width:
               5000 train / 1000 test with disjoint noise)
 
 Usage:  python -m singa_tpu.tools.convergence [mlp mlp_elastic conv alexnet]
-            [--grad_comm exact|q8|bf16] [--steps N] [--hidden_scale R]
-            [--batch N]
+            [--grad_comm exact|q8|q8wire|bf16] [--steps N]
+            [--hidden_scale R] [--batch N]
 
 Prints one JSON line per workload: {name, steps, wall_sec,
 steps_per_sec, final_test_accuracy, final_test_loss} — the convergence
@@ -21,12 +21,21 @@ table in BASELINE.md records these.
 
 ``--grad_comm`` runs the workload under a gradient-collective mode
 (parallel/collectives.py): ``q8`` = quantized int8 with error feedback,
-``bf16`` = quantized bf16, ``exact`` = an explicit exact block (must be
-bitwise-identical to no flag at all). This is the END-TO-END numerics
-validation for the quantized collective — CI's grad-comm parity gate
-runs the mlp workload with and without ``--grad_comm q8`` and asserts
-the final test loss/accuracy agree within tolerance, proving the error
-feedback preserves convergence over a whole run, not just one step.
+``q8wire`` = q8 with the reduction itself on the int8-on-the-wire
+quantized ring (``kernels { grad_allreduce: quantized_ring }``,
+ops/quantized_collective.py — run it under a >1-wide data axis, e.g.
+``XLA_FLAGS=--xla_force_host_platform_device_count=4``, or the ring is
+a trivial 0-hop loop), ``bf16`` = quantized bf16, ``exact`` = an
+explicit exact block (must be bitwise-identical to no flag at all).
+This is the END-TO-END numerics validation for the quantized
+collective — CI's grad-comm parity gate runs the mlp workload with and
+without ``--grad_comm q8`` and asserts the final test loss/accuracy
+agree within tolerance, proving the error feedback preserves
+convergence over a whole run, not just one step; the ``q8wire`` arm
+re-runs it through the ring and holds the SAME bar against ``q8``,
+proving the per-hop re-quantization (whose wire rounding goes
+un-fed-back — the documented one-shot-EF caveat) does not move
+convergence.
 ``--steps`` / ``--hidden_scale`` / ``--batch`` shrink the run for
 CPU-hosted CI (hidden_scale scales kInnerProduct widths, keeping the
 10-class head, like __graft_entry__._flagship_cfg); full-length parity
@@ -190,9 +199,11 @@ def main(argv: list[str]) -> int:
     ap.add_argument("workloads", nargs="*",
                     default=["mlp", "mlp_elastic", "conv", "alexnet"])
     ap.add_argument("--grad_comm", default="",
-                    choices=("", "exact", "q8", "bf16"),
+                    choices=("", "exact", "q8", "q8wire", "bf16"),
                     help="gradient-collective mode (q8 = quantized int8 "
-                    "with error feedback)")
+                    "with error feedback; q8wire = q8 through the "
+                    "int8-on-the-wire quantized ring, kernels { "
+                    "grad_allreduce: quantized_ring })")
     ap.add_argument("--steps", type=int, default=0,
                     help="override train_steps (CI-sized runs)")
     ap.add_argument("--hidden_scale", type=float, default=1.0,

@@ -35,6 +35,7 @@ from ..lint import (
     render_json,
     render_rule_table,
     render_text,
+    ring_rules,
     sharding_rules_static,
 )
 from ..lint.ast_rules import walk_source_files
@@ -69,8 +70,11 @@ def _lint_conf(
     if model_cfg is None:
         return
     # engine-compatibility checks need the cluster conf itself (engine
-    # selection reads nservers/synchronous, not the axis widths)
+    # selection reads nservers/synchronous, not the axis widths);
+    # ring_rules additionally reads the data-axis width for the
+    # chunk-divisibility arm (KRN002)
     engine_rules(model_cfg, cluster_cfg, path, col)
+    ring_rules(model_cfg, cluster_cfg, widths, path, col)
     if col.count("ERROR") > errors_before:
         # the graph is already known-broken; building it would only
         # re-report the same breakage through SHP001. The config-level

@@ -140,7 +140,7 @@ def main(argv: list[str] | None = None) -> int:
                     "(zero_update: true) — opt-state bytes per device "
                     "should FALL as nworkers grows")
     ap.add_argument("--grad_comm", default="",
-                    choices=("", "exact", "q8", "bf16"),
+                    choices=("", "exact", "q8", "q8wire", "bf16"),
                     help="sweep with a grad_comm block (q8 = quantized "
                     "int8 + error feedback; bf16 = quantized bf16) — "
                     "the quantized wire format should HOLD efficiency "

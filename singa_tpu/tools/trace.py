@@ -273,6 +273,23 @@ def summarize(records: list[dict]) -> dict:
         None,
     )
 
+    # training tier twin: the trainer's train.grad_allreduce
+    # kernel_select event says which wire implementation the data-axis
+    # gradient collective ran (reference | quantized_ring) and the
+    # modeled per-device bytes it moves per step — reported next to
+    # comm_ms_per_step so a post-mortem sees both the machinery's time
+    # cost and its wire cost (None = no grad_comm machinery / old log)
+    grad_select = next(
+        (
+            r["data"]
+            for r in reversed(life)
+            if r.get("kind") == "kernel_select"
+            and isinstance(r.get("data"), dict)
+            and r["data"].get("site") == "train.grad_allreduce"
+        ),
+        None,
+    )
+
     # prefix cache: per-admission prefix_hit events carry shared-block
     # and saved-prefill-chunk counts (serve/scheduler.py _admit_some)
     prefix_hit_events = [
@@ -332,6 +349,13 @@ def summarize(records: list[dict]) -> dict:
         "comm_ms_per_step": round(_percentile(comm_ms, 0.50), 4)
         if comm_ms
         else None,
+        # which wire implementation reduced gradients (the
+        # train.grad_allreduce kernel_select run-start event) and its
+        # modeled per-device data-axis bytes per step
+        "grad_wire_impl": grad_select.get("impl") if grad_select else None,
+        "wire_bytes_per_step": (
+            grad_select.get("wire_bytes_per_step") if grad_select else None
+        ),
         "counts": {
             "faults": len(faults),
             "guard_rollbacks": guard_rollbacks,

@@ -62,6 +62,10 @@ class CDTrainer(Trainer):
     """Trainer whose compiled step does CD-k instead of backprop."""
 
     _supports_buffers = False  # the CD step rewires forward via layer_hook
+    #: the CD step's layer-hooked Gibbs walk is not shard_map-wrapped:
+    #: quantized grad_comm rides the reference seam (fp32 on the wire);
+    #: kernels { grad_allreduce: quantized_ring } is rejected loudly
+    _supports_ring_collective = False
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

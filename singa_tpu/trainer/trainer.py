@@ -81,6 +81,12 @@ class Trainer:
     #: engine's EASGD rounds) reject an active grad_comm block instead
     #: of silently skipping the quantize/overlap machinery
     _supports_grad_comm = True
+    #: engines whose step can run the backward per data shard inside the
+    #: quantized-ring shard_map (kernels { grad_allreduce:
+    #: quantized_ring }); the CD engine's layer-hooked Gibbs walk stays
+    #: on the reference seam and rejects the ring instead of silently
+    #: keeping fp32 bytes on the wire
+    _supports_ring_collective = True
 
     def __init__(
         self,
@@ -174,7 +180,9 @@ class Trainer:
         # collective, traced bitwise-identically. ---
         from ..parallel.collectives import GradCommSpec
 
-        self._comm = GradCommSpec.from_config(model_cfg.grad_comm)
+        self._comm = GradCommSpec.from_config(
+            model_cfg.grad_comm, model_cfg.kernels
+        )
         if self._comm is not None and not self._supports_grad_comm:
             raise ConfigError(
                 f"{type(self).__name__} does not support grad_comm mode "
@@ -201,6 +209,17 @@ class Trainer:
                     net.param_logical = logical
         self.batch_sh = batch_shardings(self.mesh, self.train_net)
         self._repl = replicated(self.mesh)
+
+        # --- quantized ring collective (kernels { grad_allreduce:
+        # quantized_ring } — ops/quantized_collective.py): resolve each
+        # param's ring chunk dim (zero_update's data dim when the update
+        # is sharded — the ring's scatter output IS the update layout —
+        # else dim 0) and reject un-runnable geometry at construction,
+        # the same fail-early contract as the fused-attention kernel ---
+        self._ring_chunk_dims: dict[str, int] | None = None
+        self._ring_gather: dict[str, bool] | None = None
+        if self._comm is not None and self._comm.ring:
+            self._setup_ring_collective()
 
         # --- buffers (stateful layers, e.g. batch-norm running stats) ---
         self._has_buffers = bool(self.train_net.buffer_specs())
@@ -397,7 +416,8 @@ class Trainer:
             for n, slots in state.items()
         }
         self.buffers = {
-            n: jax.device_put(v, self._repl) for n, v in buffers.items()
+            n: jax.device_put(v, self._buffer_sharding(n))
+            for n, v in buffers.items()
         }
 
     def _seek_resumed_streams(self) -> None:
@@ -536,7 +556,7 @@ class Trainer:
                 for n, slots in state.items()
             }
             self.buffers = {
-                n: restore(buffer_key(n), v, self._repl)
+                n: restore(buffer_key(n), v, self._buffer_sharding(n))
                 for n, v in buffers.items()
             }
             self._resume_streams = dict(ck.streams)
@@ -695,6 +715,13 @@ class Trainer:
         inside the program (scale 1.0 is a bitwise no-op) means backing
         off needs no recompile and no host sync — and ``ok`` is this
         engine's finiteness verdict: loss + global grad-norm."""
+        if self._comm is not None and self._comm.ring:
+            # the int8-on-the-wire ring runs the backward per data
+            # shard (shard_map) so the reduction sees local partials —
+            # a different program shape, same seam contract
+            return self._ring_step_core(
+                params, state, buffers, step, batch, rng, lr_scale
+            )
 
         def loss_fn(p):
             loss, metrics, new_buffers = self.train_net.forward(
@@ -818,6 +845,394 @@ class Trainer:
             self._comm,
             self._comm_buckets(frozenset(grads)),
             self._constrain_one,
+        )
+
+    # ------------------------------------------------------------------
+    # quantized ring collective (kernels { grad_allreduce:
+    # quantized_ring } — ops/quantized_collective.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def grad_wire_impl(self) -> str:
+        """Which wire implementation the data-axis gradient reduction
+        runs ("" when no grad_comm machinery is active): ``reference``
+        (quantize around the GSPMD psum — fp32 bytes on the wire) or
+        ``quantized_ring`` (int8 bytes in explicit ppermutes)."""
+        if self._comm is None:
+            return ""
+        return self._comm.wire_impl
+
+    def _ring_ndata(self) -> int:
+        return dict(self.mesh.shape).get("data", 1)
+
+    def _setup_ring_collective(self) -> None:
+        """Resolve the ring's per-param geometry and reject un-runnable
+        configs loudly at construction (netlint KRN002 is the static
+        mirror, consulting the SAME ``ring_reducible`` predicate)."""
+        from ..ops.quantized_collective import ring_fusable, ring_reducible
+
+        if not self._supports_ring_collective:
+            raise ConfigError(
+                f"{type(self).__name__} does not support kernels "
+                "{ grad_allreduce: quantized_ring } (the ring wraps the "
+                "backward in a data-axis shard_map; this engine's step "
+                "does not take that shape)"
+            )
+        widths = dict(self.mesh.shape)
+        other = {a: w for a, w in widths.items() if a != "data" and w > 1}
+        if other:
+            raise ConfigError(
+                "kernels { grad_allreduce: quantized_ring } runs over "
+                f"the data axis only, but the mesh also shards {other} "
+                "— hierarchical (intra/inter-slice) two-level rings are "
+                "a ROADMAP carry-over"
+            )
+        ndata = self._ring_ndata()
+        bs = self.train_net.batchsize
+        if bs % max(1, ndata):
+            raise ConfigError(
+                f"quantized_ring needs the data axis ({ndata}) to divide "
+                f"the batch ({bs}): each shard computes its own local "
+                "partial gradients"
+            )
+        if self.train_net.buffer_specs():
+            # batch-stat layers (kBatchNorm is the only buffer owner)
+            # get their "sync BN over the global batch" semantics from
+            # GSPMD's implicit psums (layers/norm.py); inside the
+            # ring's shard_map the forward sees only its local shard,
+            # so batch moments would silently become per-shard stats —
+            # a biased variance, not the documented tolerance caveat
+            raise ConfigError(
+                "kernels { grad_allreduce: quantized_ring } cannot run "
+                "a net with batch-statistics buffers (kBatchNorm): the "
+                "ring's per-shard backward would turn sync BatchNorm "
+                "into local-shard BN — cross-shard batch moments inside "
+                "the ring are a ROADMAP carry-over"
+            )
+        chunk_dims: dict[str, int] = {}
+        gather: dict[str, bool] = {}
+        for name, spec in self.specs.items():
+            d, g = 0, True
+            if self._zero_sh is not None:
+                d_zero = self._zero_data_dim(name)
+                if d_zero is not None:
+                    # the ring's scatter output lands each shard's chunk
+                    # exactly where the zero update wants it — the
+                    # allgather phase is skipped for this param
+                    d, g = d_zero, False
+            chunk_dims[name] = d
+            gather[name] = g
+        shapes = {n: s.shape for n, s in self.specs.items()}
+        reason = ring_reducible(shapes, ndata, chunk_dims)
+        if reason is not None:
+            raise ConfigError(
+                f"kernels.grad_allreduce quantized_ring cannot run: "
+                f"{reason}"
+            )
+        if not self._comm.interpret:
+            reason = ring_fusable(
+                shapes, ndata, chunk_dims, interpret=False
+            )
+            if reason is not None:
+                raise ConfigError(
+                    "kernels.grad_allreduce quantized_ring with "
+                    f"interpret off cannot run: {reason}"
+                )
+        self._ring_chunk_dims = chunk_dims
+        self._ring_gather = gather
+
+    def _zero_data_dim(self, name: str) -> int | None:
+        """The dim zero_update lays over the data axis for ``name``
+        (None = the replicate fallback: no divisible free dim)."""
+        spec = self._zero_sh[name].spec
+        for i, entry in enumerate(spec):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if "data" in axes:
+                return i
+        return None
+
+    def _buffer_sharding(self, name: str):
+        """Placement for one buffer: a ring-mode error-feedback
+        residual lives in its ring-chunk layout — each data shard owns,
+        and banks the owner-side quantization error for, exactly its
+        own chunk, which is how the shard_map step emits it — so
+        sharded checkpoints save and restore matching shard boxes.
+        Everything else (and every buffer off the ring path) is
+        replicated."""
+        from ..parallel.collectives import RESIDUAL_PREFIX
+
+        if self._ring_chunk_dims is not None and name.startswith(
+            RESIDUAL_PREFIX
+        ):
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            d = self._ring_chunk_dims.get(name[len(RESIDUAL_PREFIX):])
+            if d is not None:
+                return NamedSharding(
+                    self.mesh, P(*([None] * d + ["data"]))
+                )
+        return self._repl
+
+    def _ring_specs(self):
+        """(grad out_specs, residual specs) pytrees for the ring
+        shard_map: gathered grads come out replicated (bitwise
+        identical on every shard by the allgather-from-identical-bytes
+        construction), zero-mode grads in the update layout, residuals
+        chunk-sharded over the data axis (each shard owns — and banks
+        the quantization error for — exactly its own chunk)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.collectives import residual_key
+
+        def cspec(name):
+            d = self._ring_chunk_dims[name]
+            return P(*([None] * d + ["data"]))
+
+        gspecs = {
+            n: (P() if self._ring_gather[n] else cspec(n))
+            for n in self.specs
+        }
+        rspecs = (
+            {residual_key(n): cspec(n) for n in self.specs}
+            if self._comm.wants_residuals
+            else {}
+        )
+        return gspecs, rspecs
+
+    def _ring_step_core(
+        self, params, state, buffers, step, batch, rng, lr_scale
+    ):
+        """The quantized-ring twin of ``_step_core``: forward + backward
+        run PER DATA SHARD inside a shard_map, so each shard holds its
+        own local partial gradients — the thing GSPMD's implicit psum
+        never exposes — and the data-axis reduction is the explicit
+        int8-on-the-wire ring (ops/quantized_collective.py). Loss and
+        metrics are pmean'd across shards (equal shard sizes, so the
+        mean of per-shard means is the global mean; reduction-order
+        parity with the reference path is tolerance-level, the PR 9
+        cross-shape caveat). Nets with batch-stat buffers are rejected
+        at construction — inside shard_map their moments would be
+        per-shard, not the sync-BN semantics GSPMD gives. Everything
+        downstream — the guard verdict, lr backoff, the updater — runs
+        on the reduced grads unchanged."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.quantized_collective import (
+            ring_reduce_gradients,
+            shard_map,
+        )
+        from ..parallel.collectives import is_residual_key, residual_key
+
+        spec = self._comm
+        ndata = self._ring_ndata()
+        buckets = self._comm_buckets(frozenset(params))
+        res_in = {
+            k: v for k, v in buffers.items() if is_residual_key(k)
+        }
+        passthru = {
+            k: v for k, v in buffers.items() if not is_residual_key(k)
+        }
+        gspecs, rspecs = self._ring_specs()
+
+        def body(params, passthru, res, batch, rng):
+            me = jax.lax.axis_index("data")
+            lrng = jax.random.fold_in(rng, me)
+
+            def loss_fn(p):
+                loss, metrics, new_buffers = self.train_net.forward(
+                    self._cast_compute(p), self._cast_compute(batch),
+                    training=True, rng=lrng,
+                    buffers=passthru, return_buffers=True,
+                )
+                return loss, (metrics, new_buffers)
+
+            (loss, (metrics, new_buffers)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            # each shard's loss is its LOCAL batch mean; /ndata makes
+            # the ring's cross-shard sum the global mean gradient
+            grads = {n: g / ndata for n, g in grads.items()}
+            grads, new_res = ring_reduce_gradients(
+                grads, res, buckets,
+                axis_name="data", nshards=ndata,
+                chunk_dims=self._ring_chunk_dims,
+                gather=self._ring_gather,
+                dtype=spec.dtype,
+                error_feedback=spec.error_feedback,
+                overlapped=spec.overlapped,
+                residual_key=residual_key,
+                fused_hop=not spec.interpret,
+                fused_interpret=False,
+            )
+
+            def fold(tree):
+                # float leaves are per-shard means -> pmean; non-float
+                # leaves (e.g. integer counters riding the buffer
+                # pytree) pass through untouched — they entered
+                # replicated and nothing here wrote them
+                return jax.tree.map(
+                    lambda x: jax.lax.pmean(x, "data")
+                    if jnp.issubdtype(x.dtype, jnp.floating)
+                    else x,
+                    tree,
+                )
+
+            return (
+                jax.lax.pmean(loss, "data"),
+                fold(metrics),
+                fold(new_buffers),
+                grads,
+                new_res,
+            )
+
+        fn = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), rspecs, P("data"), P()),
+            out_specs=(P(), P(), P(), gspecs, rspecs),
+            check_rep=False,
+        )
+        loss, metrics, new_buffers, grads, new_res = fn(
+            params, passthru, res_in, batch, rng
+        )
+        new_buffers = {**new_buffers, **new_res}
+        ok = None
+        if lr_scale is not None:
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm_sq(grads))
+            grads = jax.tree.map(
+                lambda g: g * lr_scale.astype(g.dtype), grads
+            )
+        params, state = self._apply_update(step, params, grads, state)
+        return params, state, new_buffers, metrics, ok
+
+    def _ring_reduce_probe(self, grads: dict, res: dict):
+        """The ring reduction in isolation (no forward) for the stall
+        tools' machinery probes: each shard treats the replicated input
+        as its local partial, so the program exercises exactly the
+        step's quantize/ppermute/accumulate work."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.quantized_collective import (
+            ring_reduce_gradients,
+            shard_map,
+        )
+        from ..parallel.collectives import residual_key
+
+        spec = self._comm
+        ndata = self._ring_ndata()
+        buckets = self._comm_buckets(frozenset(grads))
+        gspecs, rspecs = self._ring_specs()
+        gspecs = {n: gspecs[n] for n in grads}
+        rspecs = {
+            residual_key(n): rspecs[residual_key(n)]
+            for n in grads
+            if residual_key(n) in rspecs
+        }
+
+        def body(grads, res):
+            return ring_reduce_gradients(
+                {n: g / ndata for n, g in grads.items()}, res, buckets,
+                axis_name="data", nshards=ndata,
+                chunk_dims=self._ring_chunk_dims,
+                gather=self._ring_gather,
+                dtype=spec.dtype,
+                error_feedback=spec.error_feedback,
+                overlapped=spec.overlapped,
+                residual_key=residual_key,
+                fused_hop=not spec.interpret,
+                fused_interpret=False,
+            )
+
+        fn = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), rspecs), out_specs=(gspecs, rspecs),
+            check_rep=False,
+        )
+        return fn(grads, res)
+
+    def wire_bytes_model(self, ndata: int | None = None) -> dict | None:
+        """Both sides of the wire-bytes comparison for THIS trainer's
+        real param set (None with no grad_comm machinery): modeled
+        per-device bytes crossing an ``ndata``-wide data axis per step
+        as ``{"reference": .., "quantized_ring": .., "ndata": ..}`` —
+        the reference fp32 ring all-reduce (reduce-scatter alone under
+        zero_update) vs the quantized ring's ppermute payloads. The one
+        place the model's trainer plumbing (sizes, buckets, gather map,
+        zero sharding) lives: the ``kernel_select`` event,
+        tools/collective_stall.py's gated arm, and bench.py's
+        ``wire_bytes_ratio`` row all consult it. ``ndata`` defaults to
+        the mesh's real data-axis width; bench passes a nominal width
+        when the host's own axis is 1-wide (an empty wire) — a nominal
+        width the chunking could not actually divide is halved until
+        ``ring_reducible`` accepts it (never below the real width), so
+        the model's floor divisions stay exact and the priced geometry
+        is one the ring could really run."""
+        from ..ops.quantized_collective import (
+            modeled_wire_bytes,
+            reference_wire_bytes,
+            ring_reducible,
+        )
+
+        if self._comm is None:
+            return None
+        n = self._ring_ndata() if ndata is None else ndata
+        if ndata is not None and n > self._ring_ndata():
+            shapes = {nm: s.shape for nm, s in self.specs.items()}
+            while (
+                n > self._ring_ndata()
+                and ring_reducible(shapes, n, self._ring_chunk_dims)
+                is not None
+            ):
+                n //= 2
+            n = max(n, self._ring_ndata())
+        sizes = {
+            nm: int(np.prod(s.shape, dtype=np.int64))
+            for nm, s in self.specs.items()
+        }
+        return {
+            "reference": int(
+                reference_wire_bytes(
+                    sizes, n, scatter_only=self._zero_sh is not None
+                )
+            ),
+            "quantized_ring": int(
+                modeled_wire_bytes(
+                    sizes, self._comm_buckets(frozenset(sizes)), n,
+                    dtype=self._comm.dtype, gather=self._ring_gather,
+                )
+            ),
+            "ndata": n,
+        }
+
+    def modeled_wire_bytes_per_step(self) -> int:
+        """Modeled per-device bytes the ACTIVE gradient collective
+        moves across the data axis per step (0 with no machinery or a
+        1-wide axis) — ``wire_bytes_model``'s entry for the configured
+        wire implementation, what the ``kernel_select`` telemetry event
+        reports."""
+        model = self.wire_bytes_model()
+        if model is None:
+            return 0
+        return model[
+            "quantized_ring" if self._comm.ring else "reference"
+        ]
+
+    def _maybe_emit_kernel_select(self) -> None:
+        """Run-start ``kernel_select`` event for the grad_allreduce
+        site (the scheduler emits the serving-tier sibling): which wire
+        implementation this run reduces gradients through, plus the
+        modeled per-step wire bytes — what ``trace.py --summarize``
+        reports as ``grad_wire_impl`` / ``wire_bytes_per_step``."""
+        if self.telemetry is None or self._comm is None:
+            return
+        self.telemetry.event(
+            "kernel_select",
+            step=self.start_step,
+            site="train.grad_allreduce",
+            impl=self.grad_wire_impl,
+            wire_bytes_per_step=int(self.modeled_wire_bytes_per_step()),
+            wire_dtype=self._comm.dtype if self._comm.quantized else "f32",
         )
 
     def _maybe_record_comm_probe(self) -> None:
@@ -1522,7 +1937,9 @@ class Trainer:
                 if net is not None:
                     dump_net_json(net, vis)
         # comm-cost calibration span (grad_comm + telemetry only; a
-        # one-shot probe off the step path)
+        # one-shot probe off the step path) + the grad_allreduce
+        # kernel_select run-start event
+        self._maybe_emit_kernel_select()
         self._maybe_record_comm_probe()
         # streaming scan chunks: a non-cached dataset no longer falls
         # back to one dispatch per step — the stager feeds the same
