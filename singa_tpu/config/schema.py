@@ -774,6 +774,53 @@ class ServingConfig(Message):
     }
 
 
+FLEET_ROLES = ("unified", "prefill", "decode", "auto")
+FLEET_PEER_ROLES = ("unified", "prefill", "decode")
+
+
+class FleetPeerConfig(Message):
+    """One host of a disaggregated serving fleet (serve/fleet/): its
+    mailbox name and concrete role. Listed in RANK ORDER — entry k is
+    the host ``-procsID k`` launches as, the reference's hostfile
+    pattern (src/utils/cluster.cc:18-24)."""
+
+    FIELDS = {
+        "name": Field("string", required=True),
+        "role": Field("enum", "unified", enum=FLEET_PEER_ROLES),
+        # reserved for real multi-host transports (today's mailbox
+        # transport needs only the shared root)
+        "address": Field("string", ""),
+    }
+
+
+class FleetConfig(Message):
+    """singa-tpu extension: the disaggregated serving fleet
+    (singa_tpu/serve/fleet/) — the serving-scale analog of the
+    reference's rank-picks-role Worker/Server split (src/main.cc:49-55).
+    Presence of this block routes ``singa_tpu.main`` to a fleet host
+    instead of the trainer: ``role`` pins this host's role, or
+    ``auto`` (default) assigns it by rank — ranks below
+    ``prefill_hosts`` run admission + chunked prefill only and hand
+    filled sequences to decode ranks over the paged-KV block-migration
+    path; decode ranks run the fixed-shape decode tick only. Explicit
+    ``peers`` entries name the whole fleet in rank order (else
+    ``nworkers`` synthetic hosts). ``mailbox`` roots the filesystem
+    transport (default ``<workspace>/fleet``)."""
+
+    FIELDS = {
+        # this host's role; "auto" = the rank-picks-role dispatch
+        "role": Field("enum", "auto", enum=FLEET_ROLES),
+        # the fleet topology in rank order (absent = synthetic names
+        # with auto roles over the cluster's nworkers)
+        "peers": Field("message", repeated=True, message=FleetPeerConfig),
+        # with role auto: ranks [0, prefill_hosts) prefill, the rest
+        # decode
+        "prefill_hosts": Field("int", 1),
+        # shared mailbox-transport root ("" = <workspace>/fleet)
+        "mailbox": Field("string", ""),
+    }
+
+
 KERNEL_IMPLS = ("reference", "fused")
 GRAD_ALLREDUCE_IMPLS = ("reference", "quantized_ring")
 
@@ -925,6 +972,10 @@ class ModelConfig(Message):
         # hot paths, singa_tpu/ops/paged_attention.py). Absent = every
         # site runs its reference oracle path ---
         "kernels": Field("message", message=KernelsConfig),
+        # --- singa-tpu extension: disaggregated serving fleet
+        # (singa_tpu/serve/fleet/) — presence dispatches main.py to a
+        # fleet host (role by rank) instead of the trainer ---
+        "fleet": Field("message", message=FleetConfig),
     }
 
 

@@ -71,6 +71,14 @@ SRV001 = rule(
     ERROR,
     "prefix_cache enabled but kv_blocks cannot hold one max-length prompt",
 )
+FLT001 = rule(
+    "FLT001",
+    ERROR,
+    "fleet topology cannot serve: a prefill-capable host whose "
+    "kv_blocks cannot cover one max-length prompt, or a split-role "
+    "fleet missing the other half (decode with no prefill-capable "
+    "peer, prefill with no decode-capable peer)",
+)
 KRN001 = rule(
     "KRN001",
     ERROR,
@@ -408,17 +416,7 @@ def serving_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
         return
     if srv.kv_blocks <= 0:
         return  # dense-equivalent sizing always fits one sequence
-    net_cfg = model_cfg.neuralnet
-    if net_cfg is None:
-        return
-    window = max(
-        (
-            l.embedding_param.max_len
-            for l in net_cfg.layer
-            if l.embedding_param is not None and l.embedding_param.max_len
-        ),
-        default=0,
-    )
+    window = _declared_window(model_cfg)
     if not window:
         return
     block_len = max(1, srv.kv_block_len)
@@ -434,6 +432,102 @@ def serving_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
             "before the cache could ever hit",
             fix_hint=f"set kv_blocks >= {need} (or 0 for "
             "dense-equivalent sizing)",
+        )
+
+
+def _declared_window(model_cfg: ModelConfig) -> int:
+    """The model's statically-declared positional window (the
+    kEmbedding layer's ``max_len``); 0 = not statically decidable
+    (window left to the data layer's sequence length)."""
+    net_cfg = model_cfg.neuralnet
+    if net_cfg is None:
+        return 0
+    return max(
+        (
+            l.embedding_param.max_len
+            for l in net_cfg.layer
+            if l.embedding_param is not None and l.embedding_param.max_len
+        ),
+        default=0,
+    )
+
+
+def fleet_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
+    """FLT001 — static mirrors of the fleet-host construction
+    rejections (serve/fleet/host.py), SRV001's sibling. Two arms,
+    reported independently:
+
+    (a) a host that will run the PREFILL role (explicit ``role:
+        prefill``, or ``auto`` — where ranks below ``prefill_hosts``
+        always exist, or an explicit prefill ``peers`` entry) with a
+        ``serving.kv_blocks`` that cannot cover even ONE max-length
+        prompt plus the trash block: every admission would raise
+        before a single chunk ran (KVPool.for_model's runtime raise,
+        said before any pod time is burned). Skipped when the window
+        is not statically decidable, like SRV001.
+    (b) a split-role topology missing the other half: every host of
+        the lonely role raises at FleetHost construction (a decode
+        host with no prefill-capable peer has KV blocks nothing can
+        ever fill; a prefill host with no decode-capable peer fills
+        sequences that have nowhere to stream). Explicit ``peers``
+        entries ARE the topology (rank order, the runtime's
+        ``fleet_topology``); without them an explicit single role is
+        the whole fleet. ``role: auto`` without peers splits ranks at
+        runtime by a host count the model conf cannot see — skipped,
+        like SRV001's not-statically-decidable window."""
+    fleet = getattr(model_cfg, "fleet", None)
+    if fleet is None:
+        return
+    peer_roles = [p.role for p in (fleet.peers or [])]
+    if peer_roles:
+        topo_roles = set(peer_roles)
+    elif fleet.role in ("prefill", "decode", "unified"):
+        topo_roles = {fleet.role}
+    else:
+        topo_roles = None  # auto rank-split: both halves, count unknown
+    runs_prefill = (
+        topo_roles is None or topo_roles & {"prefill", "unified"}
+    )
+    srv = getattr(model_cfg, "serving", None)
+    if runs_prefill and srv is not None and srv.kv_blocks > 0:
+        window = _declared_window(model_cfg)
+        block_len = max(1, srv.kv_block_len)
+        need = -(-window // block_len) + 1 if window else 0
+        if window and srv.kv_blocks < need:
+            col.emit(
+                FLT001,
+                path,
+                f"fleet prefill host with kv_blocks {srv.kv_blocks} < "
+                f"{need} needed to admit one max-length prompt "
+                f"({window} positions / kv_block_len {block_len} + the "
+                "reserved trash block): every admission would raise "
+                "before a single prefill chunk ran",
+                fix_hint=f"set kv_blocks >= {need} (or 0 for "
+                "dense-equivalent sizing)",
+            )
+    if topo_roles is None:
+        return
+    if "decode" in topo_roles and not topo_roles & {"prefill", "unified"}:
+        col.emit(
+            FLT001,
+            path,
+            "fleet decode host(s) with no prefill-capable peer (no "
+            "topology entry of role prefill/unified): nothing can "
+            "ever fill their KV blocks — FleetHost rejects this "
+            "config at construction",
+            fix_hint="add a peers { name: ... role: prefill } entry, "
+            "or run role: unified",
+        )
+    if "prefill" in topo_roles and not topo_roles & {"decode", "unified"}:
+        col.emit(
+            FLT001,
+            path,
+            "fleet prefill host(s) with no decode-capable peer (no "
+            "topology entry of role decode/unified): filled sequences "
+            "would have nowhere to stream — FleetHost rejects this "
+            "config at construction",
+            fix_hint="add a peers { name: ... role: decode } entry, "
+            "or run role: unified",
         )
 
 
@@ -776,6 +870,7 @@ def lint_model_text(
         return None
     graph_rules(model_cfg, path, col)
     serving_rules(model_cfg, path, col)
+    fleet_rules(model_cfg, path, col)
     kernel_rules(model_cfg, path, col)
     if widths:
         sharding_rules_static(model_cfg, widths, path, col)

@@ -3,11 +3,20 @@
 Mirrors the reference binary's gflags surface (src/main.cc:13-18:
 -procsID, -hostfile, -cluster_conf, -model_conf) so reference job launch
 lines work unchanged. The worker/server role dispatch (main.cc:49-55)
-disappears: there is no parameter-server tier — every process is a trainer
-and grad sync is an XLA collective. -procsID/-hostfile feed
-jax.distributed.initialize (parallel/launch.py) when a multi-host run is
-launched reference-style; on TPU pods the runtime's own environment
-drives the rendezvous and both flags may be omitted.
+disappears for TRAINING: there is no parameter-server tier — every
+process is a trainer and grad sync is an XLA collective.
+-procsID/-hostfile feed jax.distributed.initialize (parallel/launch.py)
+when a multi-host run is launched reference-style; on TPU pods the
+runtime's own environment drives the rendezvous and both flags may be
+omitted.
+
+The rank-picks-role pattern returns at SERVING scale: a ``fleet { ... }``
+config block dispatches this process to a serving-fleet host instead
+(singa_tpu/serve/fleet/) — ``-procsID`` picks its prefill/decode/unified
+role exactly as main.cc:49-55 picked Worker vs Server, hosts exchange
+paged-KV block migrations through a shared filesystem mailbox (no
+jax.distributed rendezvous), and a SIGTERM'd host drains its in-flight
+sequences to a PEER and exits 75.
 
 Jobs run under the resilience supervisor (singa_tpu/resilience/): a
 ``resilience { ... }`` config block enables supervised auto-resume from
@@ -69,11 +78,22 @@ def main(argv: list[str] | None = None) -> int:
     from .parallel import init_distributed
 
     args = parse_args(argv)
-    init_distributed(args.procsID, args.hostfile)
     model_cfg = load_model_config(args.model_conf)
     cluster_cfg = (
         load_cluster_config(args.cluster_conf) if args.cluster_conf else None
     )
+    if getattr(model_cfg, "fleet", None) is not None:
+        # the reference's rank-picks-role dispatch (main.cc:49-55), at
+        # serving scale: a ``fleet {}`` block makes this process a
+        # serving-fleet host — -procsID picks prefill/decode/unified
+        # (serve/fleet/host.role_for_rank) and hosts share nothing but
+        # the mailbox, so no jax.distributed rendezvous is started
+        from .serve.fleet.host import run_from_conf
+
+        return run_from_conf(
+            model_cfg, cluster_cfg, procs_id=args.procsID, seed=args.seed,
+        )
+    init_distributed(args.procsID, args.hostfile)
     # persistent-compile warm start: repeat runs skip XLA recompilation
     # (cache dir from the cluster conf / workspace; SINGA_TPU_COMPILE_CACHE
     # overrides, "off" disables — utils/compile_cache.py)

@@ -28,6 +28,15 @@ loses any peer hangs forever instead of crashing):
       ``retention._sharded_valid`` checks the same markers on the
       restore side, so a half-committed save is never resumable.
 
+The serving fleet (singa_tpu/serve/fleet/) rides the same two
+disciplines at its own grain: its mailbox transport publishes every
+message and status file through ``atomic_write_bytes`` below (a
+message is absent or complete, never torn — the commit markers'
+contract), and a SIGTERM'd fleet host drains at a tick boundary and
+exits EXIT_RESUMABLE exactly like a training rank — except its
+in-flight sequences route to a PEER host over the migration path
+instead of only handing back to the launcher.
+
 No imports from the trainer package, and retention must be able to
 import this module (not the other way round).
 """
@@ -38,6 +47,18 @@ import json
 import os
 import time
 import zlib
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """Publish ``data`` at ``path`` atomically (pid-suffixed tmp +
+    rename): a reader can observe the file absent or complete, never
+    torn-but-parseable. The primitive under the commit markers below
+    AND the fleet mailbox's message/status files."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
 
 #: manifest field value declaring "this save carries commit markers"
 COMMIT_VERSION = 2
@@ -121,12 +142,10 @@ def write_commit(path: str, proc: int) -> str:
         "proc": int(proc),
         **shard_digest(os.path.join(path, f"proc_{proc}.npz")),
     }
-    mpath = commit_marker_path(path, proc)
-    tmp = mpath + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(marker, f)
-    os.replace(tmp, mpath)
-    return mpath
+    return atomic_write_bytes(
+        commit_marker_path(path, proc),
+        json.dumps(marker).encode("utf-8"),
+    )
 
 
 def commit_ok(path: str, proc: int) -> bool:

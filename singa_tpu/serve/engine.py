@@ -247,6 +247,12 @@ class Engine:
         # copy-on-write: one fixed-shape block copy (src/dst are traced
         # scalars, so every COW reuses ONE compiled program)
         self._cow_jit = jax.jit(self._cow_prog, donate_argnums=(0,))
+        # block migration (serve/fleet/migrate.py): one fixed-shape
+        # gather of a slot's whole paged state for export, one
+        # fixed-shape scatter + lane install for import — slot/rows are
+        # traced, so every migration reuses ONE compiled program each
+        self._export_jit = jax.jit(self._export_prog)
+        self._import_jit = jax.jit(self._import_prog, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -616,6 +622,54 @@ class Engine:
             ),
         }
 
+    def _export_prog(self, state, slot):
+        """Gather one slot's paged K/V through its block table — every
+        layer stacked into ONE (L, MB, H, BL, D) bulk value — plus its
+        decode lanes. The device half of block migration's export: one
+        gather per pool array, no per-block chatter (the one-shot
+        transfer shape of arxiv 1805.08430), and ``slot`` is traced so
+        every export reuses the same compiled program. Pad rows beyond
+        the sequence's allocation gather the trash block; the host side
+        trims them before serialization."""
+        row = state["tables"][slot]
+        k = jnp.stack([kp[row] for kp in state["k"]])
+        v = jnp.stack([vp[row] for vp in state["v"]])
+        return (
+            k, v, state["tokens"][slot], state["pos"][slot],
+            state["temp"][slot], state["rng"][slot],
+        )
+
+    def _import_prog(self, state, slot, table_row, scatter_row,
+                     kblk, vblk, tok, pos, temp, rng):
+        """Scatter a migrated sequence's (L, MB, H, BL, D) K/V bytes
+        into this pool's freshly allocated blocks and install its lanes
+        LIVE — the device half of block migration's import, one fused
+        dispatch. ``table_row`` is the slot's new block table;
+        ``scatter_row`` routes pad rows AND prefix-cache-shared rows to
+        the trash block (a shared block's bytes already live in this
+        pool bit-for-bit — writing them again is skipped, not risked),
+        so duplicate trash writes can only disagree about garbage the
+        attend mask zeroes exactly."""
+        new_k = tuple(
+            kp.at[scatter_row].set(kblk[i])
+            for i, kp in enumerate(state["k"])
+        )
+        new_v = tuple(
+            vp.at[scatter_row].set(vblk[i])
+            for i, vp in enumerate(state["v"])
+        )
+        return {
+            **state,
+            "k": new_k,
+            "v": new_v,
+            "tables": state["tables"].at[slot].set(table_row),
+            "tokens": state["tokens"].at[slot].set(tok),
+            "pos": state["pos"].at[slot].set(pos),
+            "temp": state["temp"].at[slot].set(temp),
+            "rng": state["rng"].at[slot].set(rng),
+            "live": state["live"].at[slot].set(True),
+        }
+
     def _cow_prog(self, state, src, dst):
         """Copy block ``src``'s K/V to block ``dst`` in every layer —
         the copy-on-write a whole-prompt prefix hit needs before its
@@ -776,6 +830,101 @@ class Engine:
             jnp.asarray(draft, jnp.int32), jnp.asarray(n_draft, jnp.int32),
         )
         return emitted, accepted
+
+    def export_slot(self, slot: int) -> dict:
+        """One admitted slot's full migratable state as host values:
+        per-layer K/V blocks gathered through the block table and
+        TRIMMED to the sequence's actual allocation, the decode lanes
+        (current token, position, temperature, RNG key — the key ships
+        bit-for-bit, so a temperature stream's continuation samples
+        through the exporter's exact key schedule), and the admission
+        digest chain (so the importer can re-register prefix-cached
+        blocks without re-hashing). The slot itself is untouched — the
+        caller retires it once the bytes are safely on the wire."""
+        blocks = self._slot_blocks.get(slot)
+        if not blocks:
+            raise ValueError(f"slot {slot} owns no blocks (not admitted?)")
+        n = len(blocks)
+        k, v, tok, pos, temp, rng = self._export_jit(
+            self.state, jnp.int32(slot)
+        )
+        return {
+            "k": np.asarray(k)[:, :n],
+            "v": np.asarray(v)[:, :n],
+            "token": int(tok),
+            "pos": int(pos),
+            "temp": float(temp),
+            "rng": np.asarray(rng),
+            "chain": list(self._slot_chain.get(slot) or ()),
+        }
+
+    def import_slot(self, slot: int, payload: dict) -> dict:
+        """Install an exported sequence into dead ``slot``: allocate
+        blocks for its K/V — SHARING this pool's cached prefix blocks
+        wherever the shipped digest chain already matches (cross-host
+        cache reuse: a matched block's bytes here are bitwise what the
+        exporter shipped, both being prefill-written under the same
+        left context) — scatter the shipped bytes into the fresh
+        blocks, install the lanes live, and register fully-prompt-
+        covered blocks under their shipped digests for future local
+        hits. Feasibility is checked BEFORE any state is touched, so a
+        backpressured import raises PoolExhausted as a true no-op (the
+        fleet host retries next tick). Only fully-prefilled (activated)
+        sequences may migrate: the chain's registration contract needs
+        every prompt position already written. -> {"blocks", "shared",
+        "registered"}."""
+        alloc = self.allocator
+        n = int(payload["k"].shape[1])
+        chain = list(payload.get("chain") or ())
+        hit: list[int] = []
+        if alloc.cache is not None and chain:
+            hit = alloc.cache.match_chain(chain)[:n]
+        fresh_n = n - len(hit)
+        if fresh_n > alloc.headroom_excluding(hit):
+            raise PoolExhausted(
+                f"import needs {fresh_n} fresh blocks beyond a "
+                f"{len(hit)}-block prefix hit, "
+                f"{alloc.headroom_excluding(hit)} allocatable"
+            )
+        if hit:
+            alloc.retain(hit)
+        fresh = alloc.alloc(fresh_n)
+        blocks = hit + fresh
+        mb = self.pool.max_blocks_per_seq
+        table_row = np.zeros((mb,), np.int32)
+        table_row[:n] = blocks
+        # shared rows + pad rows scatter to trash: their bytes are
+        # already here (shared) or masked garbage (pads)
+        scatter_row = np.zeros((mb,), np.int32)
+        scatter_row[len(hit):n] = fresh
+        shape = (self.cfg.n_layers, mb) + payload["k"].shape[2:]
+        kblk = np.zeros(shape, payload["k"].dtype)
+        vblk = np.zeros(shape, payload["v"].dtype)
+        kblk[:, :n] = payload["k"]
+        vblk[:, :n] = payload["v"]
+        self.state = self._import_jit(
+            self.state, jnp.int32(slot),
+            jnp.asarray(table_row), jnp.asarray(scatter_row),
+            jnp.asarray(kblk), jnp.asarray(vblk),
+            jnp.int32(payload["token"]), jnp.int32(payload["pos"]),
+            jnp.float32(payload["temp"]),
+            jnp.asarray(payload["rng"], jnp.uint32),
+        )
+        self._slot_blocks[slot] = blocks
+        self._slot_chain[slot] = chain
+        registered = 0
+        if alloc.cache is not None:
+            for i, digest in enumerate(chain[:n]):
+                if not alloc.cache.has(digest):
+                    registered += alloc.cache.register(
+                        digest, blocks[i],
+                        parent=chain[i - 1] if i else None,
+                    )
+        return {
+            "blocks": blocks,
+            "shared": len(hit),
+            "registered": registered,
+        }
 
     def retire(self, slot: int) -> None:
         """Release the slot's blocks (refcount decrement: shared prefix

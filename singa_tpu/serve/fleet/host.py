@@ -1,0 +1,684 @@
+"""Fleet host: one engine + scheduler wearing a role in a multi-host
+serving fleet.
+
+The reference binary picked Worker or Server by process rank
+(src/main.cc:49-55); a fleet host picks ``prefill``, ``decode``, or
+``unified`` the same way (``role_for_rank``, fed by the ``fleet {}``
+conf block and ``-procsID``):
+
+  prefill   runs admission + chunked prefill ONLY (the scheduler's
+            decode phase is gated off): once a request's prompt is
+            fully prefilled and its first token sampled, the filled
+            sequence is EXPORTED — paged KV blocks, lanes, digest
+            chain, one bulk message (fleet/migrate.py) — to the
+            least-loaded decode-capable peer. Prefill is the
+            compute-bound, batch-1 half of serving; giving it its own
+            hosts keeps long prompts from ever stealing a decode
+            tick (the disaggregation argument).
+  decode    accepts migrated sequences into free slots and runs the
+            fixed-shape decode/verify tick ONLY. It executes ZERO
+            prefill chunks — the deterministic role-split proof the
+            serve_bench ``--fleet`` gate pins.
+  unified   both halves on one host (the PR 9 single-host behavior;
+            also the degenerate 1-host fleet).
+
+Token streams are IDENTICAL to a single unified host by construction:
+migration copies pool bytes and lanes bitwise (fleet/migrate.py's
+correctness bar), and the decode program depends only on a slot's own
+lanes and table.
+
+A SIGTERM'd host drains at a tick boundary like any training rank
+(resilience/coord.py discipline) — but ``drain`` routes in-flight
+sequences to a PEER over the migration path instead of only handing
+them back to the launcher: decoding sequences migrate (their streams
+resume mid-token to full parity), prefilling/queued requests forward
+as fresh request messages (their prefill work re-runs from scratch,
+the PR 9 hand-back semantics), and only a fleet with no capable peer
+falls back to the launcher hand-back. Either way the host exits
+EXIT_RESUMABLE (75).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ...resilience.preemption import EXIT_RESUMABLE
+from ..engine import Engine, EngineConfig
+from ..kv_pool import PoolExhausted
+from ..scheduler import Request, Scheduler
+from . import migrate
+from .router import (
+    DECODE_CAPABLE,
+    MAX_PUBLISHED_DIGESTS,
+    PREFILL_CAPABLE,
+    decode_request,
+    load_score,
+)
+
+ROLES = ("unified", "prefill", "decode")
+
+#: the well-known mailbox finished streams are reported to when a host
+#: runs detached from its driver (``results_to``)
+FRONTDOOR = "frontdoor"
+
+
+def role_for_rank(fleet_cfg, rank: int) -> str:
+    """The reference's rank-picks-role dispatch (main.cc:49-55:
+    ``procsID < nworker_procs`` -> Worker, else Server): with ``role:
+    auto``, ranks below ``prefill_hosts`` prefill and the rest decode;
+    an explicit role pins every rank (the single-role fleet)."""
+    if fleet_cfg.role != "auto":
+        return fleet_cfg.role
+    return "prefill" if rank < max(1, fleet_cfg.prefill_hosts) else "decode"
+
+
+def fleet_topology(fleet_cfg, n_hosts: int) -> list[tuple[str, str]]:
+    """-> [(name, role)] in rank order. Explicit ``peers`` entries ARE
+    the topology (one per rank, the hostfile pattern); otherwise
+    ``n_hosts`` synthetic names take their role from
+    ``role_for_rank``."""
+    if fleet_cfg.peers:
+        return [(p.name, p.role) for p in fleet_cfg.peers]
+    return [
+        (f"host{k}", role_for_rank(fleet_cfg, k)) for k in range(n_hosts)
+    ]
+
+
+class FleetHost:
+    """One serving host of a fleet: a role-gated Scheduler plus the
+    migration/forwarding glue. ``peers`` maps every OTHER host's name
+    to its role (the static topology); live placement reads the
+    transport's status feedback and falls back to the static map while
+    a peer has not published yet."""
+
+    def __init__(self, name: str, role: str, engine: Engine, transport,
+                 *, peers: dict[str, str] | None = None, recorder=None,
+                 preemption=None, results_to: str | None = None,
+                 log=lambda s: None):
+        if role not in ROLES:
+            raise ValueError(f"fleet role must be one of {ROLES}, got "
+                             f"{role!r}")
+        self.name = name
+        self.role = role
+        self.engine = engine
+        self.transport = transport
+        self.peers = dict(peers or {})
+        self.results_to = results_to
+        self.preemption = preemption
+        self.log = log
+        # the runtime half of netlint FLT001: a split-role host with no
+        # peer for the other half can never finish (or never start) a
+        # stream — reject at construction, before any request is taken
+        if role == "decode" and not any(
+            r in PREFILL_CAPABLE for r in self.peers.values()
+        ):
+            raise ValueError(
+                f"decode-role host {name!r} has no prefill-capable peer: "
+                "nothing can ever fill its KV blocks (netlint FLT001 "
+                "flags this statically)"
+            )
+        if role == "prefill" and not any(
+            r in DECODE_CAPABLE for r in self.peers.values()
+        ):
+            raise ValueError(
+                f"prefill-role host {name!r} has no decode-capable peer: "
+                "filled sequences would have nowhere to stream (netlint "
+                "FLT001 flags this statically)"
+            )
+        self.sched = Scheduler(
+            engine, recorder=recorder, preemption=preemption, log=log,
+        )
+        self.sched.decode_enabled = role != "prefill"
+        #: migrated sequences awaiting a free slot / blocks (import
+        #: backpressure: deferred, never dropped)
+        self._pending: list[tuple[migrate.MigratedSequence, str]] = []
+        self._shutdown = False
+        self._reported: set[int] = set()
+        #: high-water mark into sched.finished (append-only), so each
+        #: _flush_results pass walks only NEW results — not the whole
+        #: ever-growing list every tick
+        self._flushed = 0
+        #: published-status change detection: the idle serve loop ticks
+        #: every few ms, and rewriting an identical snapshot (possibly
+        #: thousands of cached digests) through the mailbox each round
+        #: is pure filesystem churn
+        self._last_status: dict | None = None
+        self._digest_hex: tuple[int, list[str]] = (-1, [])
+        #: rotation cursor for load-score ties (_pick_peer)
+        self._rr = 0
+        self.migrate_in = 0
+        self.migrate_out = 0
+        self.blocks_in = 0
+        self.blocks_out = 0
+        transport.register(name)
+        # run-start provenance: which role this rank serves — the
+        # cross-rank merge keys its per-host rows on this event
+        self._event("fleet_role", host=name, role=role)
+        self.publish_status()
+
+    # -- plumbing -------------------------------------------------------
+
+    def _event(self, kind: str, **payload) -> None:
+        self.sched._event(kind, **payload)
+
+    def submit(self, req: Request) -> None:
+        """Direct client-side submission (the router normally delivers
+        ``request`` messages instead)."""
+        self.sched.submit(req)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.sched.busy or self._pending)
+
+    def _peer_snapshots(self, roles, exclude: str | None = None):
+        """Published statuses of capable peers, least-loaded first;
+        peers that have never published ride at the end on their
+        static-topology role (boot window). A peer whose PUBLISHED
+        role fell out of ``roles`` is excluded outright — that is how
+        a drained host's tombstone (role "drained") takes it out of
+        every placement decision."""
+        published = {
+            s.get("host"): s
+            for s in self.transport.statuses().values()
+            if s.get("host") in self.peers
+        }
+        out = [
+            s for h, s in published.items()
+            if s.get("role") in roles and h != exclude
+        ]
+        out.sort(key=load_score)
+        out.extend(
+            {"host": n, "role": r}
+            for n, r in sorted(self.peers.items())
+            if r in roles and n not in published and n != exclude
+        )
+        return out
+
+    def _pick_peer(self, roles, exclude: str | None = None) -> str | None:
+        """Least-loaded target, rotating among score TIES: published
+        statuses refresh only when a peer ticks, so two exports in one
+        round would otherwise both pile onto the same stale-idlest
+        peer (and a cold fleet would never spread at all)."""
+        snaps = self._peer_snapshots(roles, exclude=exclude)
+        if not snaps:
+            return None
+        best = load_score(snaps[0])[:3]  # name excluded: ties rotate
+        ties = [s for s in snaps if load_score(s)[:3] == best]
+        pick = ties[self._rr % len(ties)]["host"]
+        self._rr += 1
+        return pick
+
+    # -- the tick -------------------------------------------------------
+
+    def tick(self) -> int:
+        """One fleet round: drain the inbox (requests queue, migrations
+        go pending), install pending imports into free slots, run the
+        role-gated scheduler tick, export filled sequences (prefill
+        role), publish fresh status. -> tokens emitted."""
+        self._recv()
+        self._import_pending()
+        emitted = self.sched.tick()
+        if self.role == "prefill":
+            self._export_ready()
+        self._flush_results()
+        self.publish_status()
+        return emitted
+
+    def _recv(self) -> None:
+        for msg in self.transport.recv(self.name):
+            if msg.kind == "request":
+                req = decode_request(msg.payload)
+                try:
+                    self.sched.submit(req)
+                except ValueError as e:
+                    # single-host submit raises to ITS caller (the
+                    # client holding the Request); here the caller is
+                    # a wire peer, and one inadmissible request must
+                    # not take the host down — reject it back to the
+                    # front door instead
+                    self._event("reject", rid=req.rid, reason=str(e))
+                    self.log(f"fleet host {self.name}: rejected "
+                             f"request {req.rid}: {e}")
+                    if self.results_to is not None:
+                        self.transport.send(
+                            self.results_to, "result",
+                            json.dumps({
+                                "rid": req.rid, "tokens": [],
+                                "host": self.name, "error": str(e),
+                            }).encode("utf-8"),
+                            src=self.name,
+                        )
+            elif msg.kind == "migrate":
+                self._pending.append(
+                    (migrate.deserialize(msg.payload), msg.src)
+                )
+            elif msg.kind == "shutdown":
+                self._shutdown = True
+
+    def _import_pending(self) -> None:
+        """Install migrated sequences into free slots (FIFO). A full
+        pool/slot set defers the rest to the next tick — admission
+        backpressure at fleet grain, requests wait and are never
+        dropped."""
+        while self._pending:
+            free = [
+                s for s in range(self.engine.serving.slots)
+                if s not in self.sched._slot_req
+            ]
+            if not free:
+                break
+            mseq, src = self._pending[0]
+            slot = free[0]
+            try:
+                info = migrate.import_sequence(self.engine, slot, mseq)
+            except PoolExhausted:
+                self._event(
+                    "backpressure", queued=len(self._pending),
+                    free_blocks=self.engine.allocator.free_blocks,
+                    site="migrate_in",
+                )
+                break
+            self._pending.pop(0)
+            now = time.perf_counter()
+            req = Request(
+                rid=mseq.rid,
+                prompt=np.asarray(mseq.prompt, np.int32),
+                max_new_tokens=mseq.max_new_tokens,
+                temperature=mseq.temperature,
+                seed=mseq.seed,
+                eos=None if mseq.eos is None else int(mseq.eos),
+            )
+            req.status = "decoding"
+            req.slot = slot
+            req.tokens = list(mseq.emitted)
+            req._prefilled = len(req.prompt)
+            # queue-inclusive latency survives migration inside one
+            # clock domain; a cross-host import re-stamps at arrival
+            req.enqueue_mono = mseq.enqueue_mono or now
+            req.admit_mono = req.enqueue_mono
+            req.admit_wall = time.time()
+            req.first_token_mono = now
+            self.sched._slot_req[slot] = req
+            self.migrate_in += 1
+            self.blocks_in += mseq.n_blocks
+            self._event(
+                "migrate_in", rid=req.rid, src=src, slot=slot,
+                blocks=mseq.n_blocks, shared=info["shared"],
+                registered=info["registered"],
+                tokens_done=len(req.tokens),
+            )
+
+    def _export_ready(self) -> None:
+        """Ship every filled (decoding-status) sequence to a decode
+        peer. With no peer reachable the sequence WAITS in its slot —
+        the decode gate keeps it frozen, nothing is lost."""
+        for slot in sorted(self.sched._slot_req):
+            req = self.sched._slot_req[slot]
+            if req.status != "decoding":
+                continue
+            dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
+            if dst is None:
+                break
+            self._export_to(slot, req, dst)
+
+    def _export_to(self, slot: int, req: Request, dst: str) -> None:
+        mseq = migrate.export_sequence(self.engine, req, slot)
+        data = migrate.serialize(mseq)
+        self.transport.send(dst, "migrate", data, src=self.name)
+        # the slot frees for the next admission; registered prefix
+        # blocks park on OUR LRU too — the same prompt now serves
+        # prefix hits on both hosts
+        self.engine.retire(slot)
+        del self.sched._slot_req[slot]
+        self.migrate_out += 1
+        self.blocks_out += mseq.n_blocks
+        self._event(
+            "migrate_out", rid=req.rid, dst=dst, slot=slot,
+            blocks=mseq.n_blocks, bytes=len(data),
+            tokens_done=len(req.tokens),
+        )
+
+    def _flush_results(self) -> None:
+        if self.results_to is None:
+            return
+        # finished is append-only; an external clear (bench warmup
+        # resets) can only shrink it, so clamp and rescan from there
+        self._flushed = min(self._flushed, len(self.sched.finished))
+        new, self._flushed = (
+            self.sched.finished[self._flushed:],
+            len(self.sched.finished),
+        )
+        for req in new:
+            if req.rid in self._reported:
+                continue
+            self._reported.add(req.rid)
+            self.transport.send(
+                self.results_to, "result",
+                json.dumps({
+                    "rid": req.rid,
+                    "tokens": [int(t) for t in req.tokens],
+                    "host": self.name,
+                }).encode("utf-8"),
+                src=self.name,
+            )
+
+    # -- status feedback ------------------------------------------------
+
+    def status(self) -> dict:
+        s = {
+            "host": self.name,
+            "role": self.role,
+            "free_slots": self.engine.serving.slots
+            - len(self.sched._slot_req),
+            "kv_blocks_free": self.engine.allocator.free_blocks,
+            "queue_depth": len(self.sched._queue) + len(self._pending),
+            "live": len(self.sched._slot_req),
+        }
+        cache = self.engine.allocator.cache
+        if cache is not None:
+            # hexing thousands of digests every tick is the hot-path
+            # cost here — re-derive only when the index changed
+            if self._digest_hex[0] != cache.version:
+                self._digest_hex = (cache.version, [
+                    d.hex() for d in cache.digests(MAX_PUBLISHED_DIGESTS)
+                ])
+            s["cached_digests"] = self._digest_hex[1]
+        return s
+
+    def publish_status(self) -> None:
+        s = self.status()
+        if s != self._last_status:
+            self._last_status = s
+            self.transport.publish(self.name, s)
+
+    # -- drain-to-peer --------------------------------------------------
+
+    def drain(self, reason: str, *, grace_s: float = 0.0) -> dict:
+        """Preemption drain, fleet edition: decoding sequences MIGRATE
+        to a decode-capable peer (their streams resume mid-token, to
+        full parity), prefilling and queued requests FORWARD to a
+        prefill-capable peer as fresh requests (prefill re-runs from
+        scratch, the PR 9 hand-back semantics), and only with no
+        capable peer does a request fall back to the launcher
+        hand-back. ``grace_s`` > 0 keeps reading the inbox for that
+        long AFTER the tombstone publishes, re-forwarding stragglers —
+        on a cross-process transport a peer that read our
+        pre-tombstone status may have a migrate message (the ONLY copy
+        of its sequence) already in flight; single-threaded in-process
+        drills have no concurrent senders and keep the default 0. The
+        caller exits EXIT_RESUMABLE (75)."""
+        # absorb anything already delivered to our inbox: a migrate
+        # message a peer sent before seeing the tombstone must re-enter
+        # the fleet through the forwarding below, not rot unread
+        self._recv()
+        self._event(
+            "drain", reason=reason,
+            in_flight=len(self.sched._slot_req),
+            queued=len(self.sched._queue) + len(self._pending),
+        )
+        migrated, forwarded, handed_back = [], [], []
+        for slot in sorted(self.sched._slot_req):
+            req = self.sched._slot_req[slot]
+            if req.status == "decoding":
+                dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
+                if dst is not None:
+                    self._event(
+                        "evict", rid=req.rid, slot=slot, state="migrated",
+                        tokens_done=len(req.tokens), dst=dst,
+                    )
+                    self._export_to(slot, req, dst)
+                    migrated.append(
+                        {"rid": req.rid, "dst": dst,
+                         "tokens_done": len(req.tokens)}
+                    )
+                    continue
+            dst = self._pick_peer(PREFILL_CAPABLE, exclude=self.name)
+            self.engine.retire(slot)
+            del self.sched._slot_req[slot]
+            req.status = "evicted"
+            state = "forwarded" if dst is not None else "in_flight"
+            self._event(
+                "evict", rid=req.rid, slot=slot, state=state,
+                tokens_done=len(req.tokens), prefilled=req._prefilled,
+            )
+            if dst is not None:
+                from .router import encode_request
+
+                self.transport.send(
+                    dst, "request", encode_request(req), src=self.name,
+                )
+                forwarded.append({"rid": req.rid, "dst": dst})
+            else:
+                handed_back.append(
+                    {"rid": req.rid, "tokens_done": len(req.tokens)}
+                )
+        # pending (not-yet-installed) imports re-enter the fleet as
+        # fresh requests: their KV was never scattered here, so the
+        # hand-back semantics (re-prefill from scratch) are the honest
+        # ones — the partial output was already delivered at export
+        pending_reqs = [
+            Request(
+                rid=m.rid,
+                prompt=np.asarray(m.prompt, np.int32),
+                max_new_tokens=m.max_new_tokens,
+                temperature=m.temperature,
+                seed=m.seed,
+                eos=None if m.eos is None else int(m.eos),
+            )
+            for m, _ in self._pending
+        ]
+        self._pending.clear()
+        for req in list(self.sched._queue) + pending_reqs:
+            dst = self._pick_peer(PREFILL_CAPABLE, exclude=self.name)
+            if dst is not None:
+                from .router import encode_request
+
+                self.transport.send(
+                    dst, "request", encode_request(req), src=self.name,
+                )
+                forwarded.append({"rid": req.rid, "dst": dst})
+            else:
+                handed_back.append({"rid": req.rid, "tokens_done": 0})
+        self.sched._queue.clear()
+        # the tombstone: a published role no placement accepts takes
+        # this host out of every peer's candidate set (its static
+        # topology entry stops mattering once it has published)
+        self.transport.publish(
+            self.name, {**self.status(), "role": "drained"},
+        )
+        if grace_s > 0:
+            deadline = time.monotonic() + grace_s
+            while True:
+                for msg in self.transport.recv(self.name):
+                    self._reroute_straggler(
+                        msg, migrated, forwarded, handed_back,
+                    )
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.01)
+        if self.sched.recorder is not None:
+            self.sched.recorder.flush()
+        return {
+            "reason": reason,
+            "migrated": migrated,
+            "forwarded": forwarded,
+            "handed_back": handed_back,
+            "finished": [r.rid for r in self.sched.finished],
+        }
+
+    def _reroute_straggler(self, msg, migrated, forwarded,
+                           handed_back) -> None:
+        """Re-forward one inbox message that arrived mid-drain. The
+        payloads are self-contained, so a straggler moves to a capable
+        peer as the SAME raw bytes under a fresh envelope — a migrate
+        keeps its mid-stream device state (deserialized only for
+        accounting), a request keeps its stamp semantics."""
+        if msg.kind == "migrate":
+            mseq = migrate.deserialize(msg.payload)
+            dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
+            if dst is not None:
+                self.transport.send(
+                    dst, "migrate", msg.payload, src=self.name,
+                )
+                self._event(
+                    "migrate_out", rid=mseq.rid, dst=dst, slot=-1,
+                    blocks=mseq.n_blocks, bytes=len(msg.payload),
+                    tokens_done=len(mseq.emitted), rerouted=True,
+                )
+                migrated.append(
+                    {"rid": mseq.rid, "dst": dst,
+                     "tokens_done": len(mseq.emitted)}
+                )
+            else:
+                handed_back.append(
+                    {"rid": mseq.rid,
+                     "tokens_done": len(mseq.emitted)}
+                )
+        elif msg.kind == "request":
+            req = decode_request(msg.payload)
+            dst = self._pick_peer(PREFILL_CAPABLE, exclude=self.name)
+            if dst is not None:
+                self.transport.send(
+                    dst, "request", msg.payload, src=self.name,
+                )
+                forwarded.append({"rid": req.rid, "dst": dst})
+            else:
+                handed_back.append({"rid": req.rid, "tokens_done": 0})
+
+    # -- detached serve loop (the OS-process / main.py path) ------------
+
+    def serve_forever(self, *, idle_sleep: float = 0.002,
+                      max_idle_s: float | None = None,
+                      drain_grace_s: float = 0.5):
+        """Tick until a shutdown message arrives and the host runs dry
+        (or a preemption drains it, or ``max_idle_s`` of continuous
+        idleness passes — the watchdog for a driver that died). The
+        preemption check runs FIRST each round, the serve-loop
+        discipline scheduler.serve follows. -> (exit code, drain
+        accounting | None)."""
+        idle_since = None
+        while True:
+            if self.preemption is not None and self.preemption.requested:
+                acct = self.drain(
+                    self.preemption.reason or "preempted",
+                    grace_s=drain_grace_s,
+                )
+                return EXIT_RESUMABLE, acct
+            emitted = self.tick()
+            if self.busy or emitted:
+                idle_since = None
+                continue
+            if self._shutdown:
+                self._flush_results()
+                return 0, None
+            now = time.monotonic()
+            idle_since = idle_since if idle_since is not None else now
+            if max_idle_s is not None and now - idle_since > max_idle_s:
+                self.log(f"fleet host {self.name}: idle past "
+                         f"{max_idle_s:g}s, exiting")
+                return 0, None
+            time.sleep(idle_sleep)
+
+
+# ---------------------------------------------------------------------------
+# conf-driven entry (main.py plumbing)
+# ---------------------------------------------------------------------------
+
+
+def lm_config_from_conf(model_cfg):
+    """Engine geometry from the conf net's declared dims: the
+    kEmbedding layer's vocab/width/window, the kAttention layers'
+    head count and depth. The fleet serves the code-API LM at that
+    geometry with seed-initialized weights (every rank inits the same
+    params from the same seed, the mp drills' discipline); loading
+    trained weights through the ``checkpoint`` field is a README'd
+    remaining item."""
+    from ...models.transformer import TransformerConfig
+
+    net = model_cfg.neuralnet
+    if net is None:
+        raise ValueError("fleet conf has no neuralnet block")
+    emb = next(
+        (l.embedding_param for l in net.layer
+         if l.embedding_param is not None), None,
+    )
+    heads = [
+        l.attention_param.num_heads for l in net.layer
+        if l.attention_param is not None
+    ]
+    if emb is None or not heads:
+        raise ValueError(
+            "fleet conf needs a kEmbedding layer (vocab_size, "
+            "embedding_dim, max_len) and at least one kAttention layer"
+        )
+    if not emb.max_len:
+        raise ValueError(
+            "fleet conf's kEmbedding must declare max_len (the serving "
+            "window cannot come from a data layer that never runs here)"
+        )
+    d = emb.embedding_dim
+    return TransformerConfig(
+        vocab=emb.vocab_size, d_model=d, n_heads=heads[0],
+        n_layers=len(heads), d_ff=4 * d, max_len=emb.max_len,
+    )
+
+
+def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
+                  seed: int = 0, log=print) -> int:
+    """The ``fleet {}`` dispatch target of ``singa_tpu.main``: build
+    this rank's engine, take the role ``role_for_rank`` assigns, wire
+    the workspace mailbox, and serve until shutdown / SIGTERM (exit 75
+    after a drain-to-peer). The launch line is the reference's
+    (``-procsID k`` per host); no jax.distributed rendezvous is needed
+    — fleet hosts share nothing but the mailbox."""
+    import jax
+
+    from ...models.transformer import init_lm
+    from ...obs.recorder import FlightRecorder
+    from ...resilience.preemption import PreemptionHandler
+    from .transport import Mailbox
+
+    fleet = model_cfg.fleet
+    n_hosts = len(fleet.peers) or (
+        cluster_cfg.nworkers if cluster_cfg is not None
+        and cluster_cfg.nworkers else 1
+    )
+    topo = fleet_topology(fleet, n_hosts)
+    if not 0 <= procs_id < len(topo):
+        raise ValueError(
+            f"-procsID {procs_id} out of range for a {len(topo)}-host "
+            "fleet"
+        )
+    name, role = topo[procs_id]
+    workspace = (
+        cluster_cfg.workspace if cluster_cfg is not None else "."
+    )
+    root = fleet.mailbox or f"{workspace}/fleet"
+    cfg = lm_config_from_conf(model_cfg)
+    params = init_lm(jax.random.PRNGKey(seed), cfg)
+    serving = EngineConfig.from_conf(
+        model_cfg.serving, getattr(model_cfg, "kernels", None)
+    )
+    engine = Engine(params, cfg, serving)
+    recorder = FlightRecorder(
+        f"{workspace}/events", rank=procs_id, run_id="fleet",
+    )
+    handler = PreemptionHandler()
+    handler.install()
+    log(f"fleet host {name!r} (rank {procs_id}): role {role}, "
+        f"mailbox {root}")
+    host = FleetHost(
+        name, role, engine, Mailbox(root),
+        peers={n: r for n, r in topo if n != name},
+        recorder=recorder, preemption=handler,
+        results_to=FRONTDOOR, log=log,
+    )
+    rc, acct = host.serve_forever()
+    if acct is not None:
+        log("FLEET DRAIN: " + json.dumps(acct))
+    recorder.event("run_stop", step=host.sched.ticks, exit_code=rc)
+    recorder.close()
+    return rc

@@ -1,0 +1,175 @@
+"""Paged-KV block migration: one sequence's serving state as ONE bulk
+message between hosts.
+
+The reference moved parameter state through a request/response Router
+tier (include/utils/router.h:16-57) — fine for kGet/kPut of one param
+blob, hopeless for shipping a sequence's whole paged KV (hundreds of
+blocks x layers of per-request chatter). "RPC Considered Harmful"
+(arxiv 1805.08430) names the fix this module implements: bulk tensor
+state moves as a ONE-SHOT device-to-wire transfer — gather the
+sequence's blocks from the pool through its block table (one compiled
+gather per export, engine._export_prog), serialize
+``(blocks, block_table, pos, emitted tokens, rng lane, digest chain)``
+as a single message, scatter into the peer pool's freshly allocated
+blocks (one compiled scatter per import, engine._import_prog). No
+per-block round trips, no wire format per layer.
+
+The correctness bar is BITWISE: an imported sequence's subsequent
+token stream is bit-for-bit the stream the exporting host would have
+produced. That rides the PR 9 pinning chain — paged == dense is
+bitwise, the gathered view reassembles exactly the dense layout, and a
+slot's decode depends only on its own lanes and table — so copying
+pool bytes + (token, pos, temp, rng) lanes exactly IS copying the
+stream's future. The RNG lane ships bit-for-bit, so temperature
+streams keep sampling through the exporter's exact key schedule.
+
+Prefix-cache-registered blocks re-register on the importer via their
+CHAINED digests (shipped, not re-hashed): a matched digest means the
+importer already holds those bytes bit-for-bit (both sides
+prefill-written under the same left context, the PR 11 invariant), so
+import shares the matched blocks instead of re-writing them, and
+newly imported full-prompt blocks join the importer's index — cross-
+host cache reuse for the price of a list of digests on the wire.
+
+Serialization is numpy's npz container (every array in one buffer)
+plus a JSON metadata record — self-describing, versioned, no pickle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+
+import numpy as np
+
+#: wire-format tag; bump on any incompatible layout change
+MIGRATE_FORMAT = "singa-tpu-migrate-v1"
+
+
+@dataclasses.dataclass
+class MigratedSequence:
+    """One in-flight sequence on the wire: the request's identity and
+    budget bookkeeping (scheduler side) plus the engine's exported
+    device state (``payload``: trimmed per-layer K/V blocks, lanes,
+    digest chain — serve/engine.py ``Engine.export_slot``)."""
+
+    rid: int
+    prompt: np.ndarray
+    emitted: list
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    eos: int | None
+    payload: dict
+    #: submit-time monotonic stamp, carried so queue-inclusive latency
+    #: survives migration (meaningful within one process/clock domain;
+    #: cross-host reports fall back to import-time re-stamping)
+    enqueue_mono: float = 0.0
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.payload["k"].shape[1])
+
+
+def export_sequence(engine, req, slot: int) -> MigratedSequence:
+    """Gather ``slot``'s full serving state for request ``req`` into a
+    wire-ready MigratedSequence. The slot is left serving; the caller
+    retires it once the message is handed to the transport (after
+    which the exporter's registered prefix blocks park on its LRU —
+    the SAME prompt keeps serving prefix hits on BOTH hosts)."""
+    return MigratedSequence(
+        rid=req.rid,
+        prompt=np.asarray(req.prompt, np.int32),
+        emitted=list(req.tokens),
+        max_new_tokens=int(req.max_new_tokens),
+        temperature=float(req.temperature),
+        seed=int(req.seed),
+        eos=req.eos,
+        payload=engine.export_slot(slot),
+        enqueue_mono=float(req.enqueue_mono),
+    )
+
+
+def import_sequence(engine, slot: int, mseq: MigratedSequence) -> dict:
+    """Install ``mseq`` into dead ``slot`` of ``engine`` (raises
+    PoolExhausted untouched — fleet import backpressure, the caller
+    retries next tick). -> the engine's import info
+    ({"blocks", "shared", "registered"})."""
+    return engine.import_slot(slot, mseq.payload)
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+def serialize(mseq: MigratedSequence) -> bytes:
+    """MigratedSequence -> one self-describing bytes message (npz
+    container: arrays + a JSON metadata entry)."""
+    p = mseq.payload
+    meta = {
+        "format": MIGRATE_FORMAT,
+        "rid": mseq.rid,
+        "emitted": [int(t) for t in mseq.emitted],
+        "max_new_tokens": mseq.max_new_tokens,
+        "temperature": mseq.temperature,
+        "seed": mseq.seed,
+        "eos": mseq.eos,
+        # per-process perf_counter origin: a cross-process importer
+        # re-stamps at arrival instead of trusting a foreign clock
+        "enqueue_mono": mseq.enqueue_mono,
+        "clock": os.getpid(),
+        "token": int(p["token"]),
+        "pos": int(p["pos"]),
+        "temp": float(p["temp"]),
+        "chain": [d.hex() for d in p.get("chain") or ()],
+    }
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        ),
+        prompt=np.asarray(mseq.prompt, np.int32),
+        k=np.asarray(p["k"]),
+        v=np.asarray(p["v"]),
+        rng=np.asarray(p["rng"], np.uint32),
+    )
+    return buf.getvalue()
+
+
+def deserialize(data: bytes) -> MigratedSequence:
+    """bytes -> MigratedSequence (raises ValueError on a foreign or
+    future wire format — a fleet must not silently mis-scatter)."""
+    with np.load(io.BytesIO(data)) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        if meta.get("format") != MIGRATE_FORMAT:
+            raise ValueError(
+                f"migrate message format {meta.get('format')!r} != "
+                f"{MIGRATE_FORMAT!r}"
+            )
+        payload = {
+            "k": z["k"],
+            "v": z["v"],
+            "rng": z["rng"],
+            "token": int(meta["token"]),
+            "pos": int(meta["pos"]),
+            "temp": float(meta["temp"]),
+            "chain": [bytes.fromhex(h) for h in meta["chain"]],
+        }
+        return MigratedSequence(
+            rid=int(meta["rid"]),
+            prompt=z["prompt"],
+            emitted=list(meta["emitted"]),
+            max_new_tokens=int(meta["max_new_tokens"]),
+            temperature=float(meta["temperature"]),
+            seed=int(meta["seed"]),
+            eos=meta["eos"],
+            payload=payload,
+            enqueue_mono=(
+                float(meta.get("enqueue_mono", 0.0))
+                if meta.get("clock") == os.getpid() else 0.0
+            ),
+        )

@@ -147,6 +147,10 @@ class PrefixCache:
 
     def __init__(self, block_len: int):
         self.block_len = block_len
+        #: bumped on every index mutation (register/forget) — cheap
+        #: change detection for consumers that derive state from the
+        #: index (the fleet host's published digest feedback)
+        self.version = 0
         self._by_digest: dict[bytes, int] = {}
         self._digest_of: dict[int, bytes] = {}
         #: digest -> parent digest (None for a chain head) and the
@@ -196,6 +200,14 @@ class PrefixCache:
     def has(self, digest: bytes) -> bool:
         return digest in self._by_digest
 
+    def digests(self, limit: int | None = None) -> list[bytes]:
+        """Up to ``limit`` indexed digests (insertion order — chain
+        parents precede children, so a truncated list still matches
+        prefixes). The fleet router's prefix-affinity feedback
+        publishes these (serve/fleet/router.py)."""
+        out = list(self._by_digest)
+        return out if limit is None else out[:limit]
+
     def is_cached(self, block: int) -> bool:
         return block in self._digest_of
 
@@ -208,6 +220,7 @@ class PrefixCache:
         private."""
         if digest in self._by_digest or block in self._digest_of:
             return False
+        self.version += 1
         self._by_digest[digest] = block
         self._digest_of[block] = digest
         self._parent[digest] = parent
@@ -225,6 +238,7 @@ class PrefixCache:
         d = self._digest_of.get(block)
         if d is None:
             return []
+        self.version += 1
         removed: list[int] = []
         stack = [d]
         while stack:

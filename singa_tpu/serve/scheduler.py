@@ -110,6 +110,12 @@ class Scheduler:
             )
         self.spec_drafted = 0
         self.spec_accepted = 0
+        #: role gate for the fleet's prefill/decode split
+        #: (serve/fleet/host.py): False = ticks run admission + chunked
+        #: prefill only and decoding-status requests wait for the fleet
+        #: host to migrate them to a decode peer. True (default) = the
+        #: unified single-host behavior.
+        self.decode_enabled = True
         #: prefix-cache accounting (all zero with the cache off)
         self.prefix_lookups = 0
         self.prefix_hits = 0
@@ -177,7 +183,10 @@ class Scheduler:
                 f"{self.engine.cfg.max_len}"
             )
         req.prompt = np.asarray(req.prompt, np.int32)
-        req.enqueue_mono = time.perf_counter()
+        # a request that crossed the fleet's front door (or a drain
+        # forward) keeps its original stamp, so queue-inclusive latency
+        # covers the routing hop too; fresh requests stamp here
+        req.enqueue_mono = req.enqueue_mono or time.perf_counter()
         req.status = "queued"
         self._queue.append(req)
 
@@ -345,9 +354,20 @@ class Scheduler:
         admit fills freed slots, prefill advances one chunk each, then
         every live slot decodes — one token through the decode program,
         or up to spec_k + 1 through the verify program when speculation
-        is on. -> tokens emitted."""
+        is on (skipped entirely on a prefill-role fleet host,
+        ``decode_enabled`` False). -> tokens emitted."""
         self._admit_some()
         self._prefill_some()
+        emitted_n = self._decode_some() if self.decode_enabled else 0
+        self.ticks += 1
+        return emitted_n
+
+    def _decode_some(self) -> int:
+        """The decode phase of one tick: every decoding-status slot
+        advances through the decode (or speculative verify) program,
+        accepted runs fan out to their requests, EOS/budget retires
+        inline. Split out of ``tick`` so a fleet host can compose
+        role-gated rounds (serve/fleet/host.py). -> tokens emitted."""
         decoding = {
             s: r for s, r in self._slot_req.items() if r.status == "decoding"
         }
@@ -406,7 +426,6 @@ class Scheduler:
                 "decode_tick", live=len(decoding), emitted=emitted_n,
                 blocks_used=self.engine.allocator.used_blocks,
             )
-        self.ticks += 1
         return emitted_n
 
     # -- loops ----------------------------------------------------------
@@ -471,6 +490,13 @@ class Scheduler:
             "kv_blocks_peak": self.engine.allocator.peak_used,
             "kv_blocks_total": self.engine.pool.n_blocks - 1,
             "backpressure_ticks": self.backpressure_ticks,
+            # instantaneous feedback the fleet router's least-loaded
+            # placement keys on (serve/fleet/router.py): slots with no
+            # live request, allocatable blocks (free + reclaimable LRU),
+            # and the request queue's current depth
+            "free_slots": self.engine.serving.slots - len(self._slot_req),
+            "kv_blocks_free": self.engine.allocator.free_blocks,
+            "queue_depth": len(self._queue),
         }
         if self.spec_k > 0:
             # acceptance rate = accepted draft tokens / drafted; the

@@ -140,6 +140,29 @@ def build_argparser() -> argparse.ArgumentParser:
                     "paged-attention kernel (interpret mode off-TPU; "
                     "baselines always run reference, so the gate "
                     "doubles as a stream-identity check)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="benchmark a DISAGGREGATED FLEET instead: "
+                    "--fleet_hosts role-split hosts (one engine each, "
+                    "serve/fleet/) behind the front-door router, vs "
+                    "one unified host at the same per-host slots. "
+                    "Or-gate: fleet tokens/sec >= --fleet_threshold x "
+                    "single-host, OR decode-host prefill-chunks-"
+                    "executed == 0 with >= 1 migration (the "
+                    "deterministic role-split proof). Streams must "
+                    "match the single host either way.")
+    ap.add_argument("--fleet_hosts", default="prefill,decode",
+                    help="comma-separated roles, one host per entry "
+                    "(rank order; names are role+index, e.g. "
+                    "prefill0,decode0)")
+    ap.add_argument("--fleet_threshold", type=float, default=1.5,
+                    help="min fleet tokens/sec over the single host "
+                    "(or-gated with the role-split proof)")
+    ap.add_argument("--sigterm_host", default=None,
+                    help="with --fleet and --sigterm_at_tick: the host "
+                    "(by name, or by role = its first host) whose "
+                    "preemption plane fires — it drains its in-flight "
+                    "sequences TO A PEER and the fleet finishes "
+                    "without it; exit 75, streams still identical")
     ap.add_argument("--arrival", default="batch",
                     choices=("batch", "poisson"),
                     help="'poisson' adds a seeded open-loop arrival "
@@ -440,6 +463,304 @@ def run_poisson(params, cfg, prompts, args, recorder=None):
     return sched, elapsed, lat_ms
 
 
+def build_fleet(params, cfg, args, *, transport=None):
+    """Hosts (one engine each) + router per ``--fleet_hosts``, wired
+    over an in-process transport — the whole multi-host fleet in one
+    process, deterministic, with the REAL migration wire bytes.
+    -> (hosts, router, transport)."""
+    from ..serve import Engine, EngineConfig
+    from ..serve.fleet import FleetHost, LocalTransport, Router
+
+    roles = [r.strip() for r in args.fleet_hosts.split(",") if r.strip()]
+    if not roles:
+        raise ValueError("--fleet_hosts named no hosts")
+    names, seen = [], {}
+    for role in roles:
+        seen[role] = seen.get(role, 0)
+        names.append(f"{role}{seen[role]}")
+        seen[role] += 1
+    topo = list(zip(names, roles))
+    ec = EngineConfig(
+        slots=args.concurrency,
+        kv_block_len=args.block_len,
+        kv_blocks=args.kv_blocks,
+        max_prefill_chunk=args.prefill_chunk,
+        spec_k=args.speculate_k,
+        spec_drafter=args.spec_drafter,
+        prefix_cache=args.prefix_cache,
+        attend_impl=args.kernels,
+    )
+    transport = transport or LocalTransport()
+    hosts = [
+        FleetHost(
+            name, role, Engine(params, cfg, ec), transport,
+            peers={n: r for n, r in topo if n != name},
+        )
+        for name, role in topo
+    ]
+    router = Router(
+        transport, block_len=args.block_len if args.prefix_cache else 0,
+    )
+    return hosts, router, transport
+
+
+def run_fleet(params, cfg, prompts, args, *, recorders=None,
+              router_recorder=None, sigterm_at_tick=0,
+              sigterm_target=None):
+    """Drive the request workload through the fleet (batch submit or
+    the --arrival poisson open loop). ``sigterm_at_tick`` triggers the
+    target host's preemption plane at that fleet round — it drains to
+    a PEER and the fleet finishes without it. -> (hosts, router,
+    elapsed_s, streams {rid: tokens}, queue-inclusive latencies ms,
+    drain accounting | None)."""
+    import numpy as np
+
+    from ..serve import Request
+
+    hosts, router, _ = build_fleet(params, cfg, args)
+    by_name = {h.name: h for h in hosts}
+    if sigterm_at_tick:
+        if sigterm_target in by_name:
+            target = by_name[sigterm_target]
+        else:
+            target = next(
+                (h for h in hosts if h.role == (sigterm_target or "decode")),
+                None,
+            )
+            if target is None:
+                raise ValueError(
+                    f"--sigterm_host {sigterm_target!r} names no fleet "
+                    "host"
+                )
+    # compile-warm EVERY host's programs through the REAL fleet path
+    # (prefill on prefill hosts, import+decode on decode hosts): one
+    # warm request per decode-capable host — the tie-rotating export
+    # spreads them, so no host compiles inside the measured window —
+    # then zero the counters and attach recorders only after, so
+    # compile time never pollutes the serving percentiles
+    per_wave = max(
+        1, sum(1 for h in hosts if h.role in ("decode", "unified"))
+    )
+    waves = 2 if args.prefix_cache else 1
+    rid = -1
+    for _ in range(waves):
+        for _ in range(per_wave):
+            router.submit(Request(rid=rid, prompt=np.asarray(prompts[0]),
+                                  max_new_tokens=2))
+            rid -= 1
+        idle = 0
+        for _ in range(10 ** 4):
+            for h in hosts:
+                h.tick()
+            # an in-flight export sits in the transport for one round;
+            # only consecutive idle rounds mean the fleet ran dry
+            idle = idle + 1 if not any(h.busy for h in hosts) else 0
+            if idle >= 3:
+                break
+    for h in hosts:
+        h.sched.finished.clear()
+        h.sched.reset_counters()
+        h.migrate_in = h.migrate_out = 0
+        h.blocks_in = h.blocks_out = 0
+        h.engine.allocator.peak_used = h.engine.allocator.used_blocks
+    router.routed = router.affinity_hits = 0
+    if recorders:
+        for h, rec in zip(hosts, recorders):
+            h.sched.recorder = rec
+            h._event("fleet_role", host=h.name, role=h.role)
+            h._event(
+                "kernel_select", site="serve.paged_attention",
+                impl=args.kernels,
+            )
+    router.recorder = router_recorder
+
+    if args.arrival == "poisson":
+        rs = np.random.RandomState(args.seed + 1)
+        arrivals = np.cumsum(
+            rs.exponential(1.0 / max(args.rate, 1e-9), size=len(prompts))
+        )
+        pending = list(zip(arrivals, range(len(prompts))))
+    else:
+        pending = [(0.0, i) for i in range(len(prompts))]
+    acct = None
+    dead: set = set()
+    rids = set(range(len(prompts)))
+    tick = 0
+    idle_rounds = 0
+    t0 = time.perf_counter()
+    while True:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, i = pending.pop(0)
+            router.submit(Request(
+                rid=i, prompt=prompts[i], max_new_tokens=args.max_new,
+                seed=args.seed + i,
+            ))
+        if (
+            sigterm_at_tick and tick >= sigterm_at_tick
+            and target.name not in dead
+        ):
+            # the deterministic drill: the preemption plane's flag path
+            # is identical to a real SIGTERM's, then the host drains to
+            # its peers and stops ticking (the process is "gone")
+            acct = target.drain(f"sigterm_at_tick {sigterm_at_tick}")
+            dead.add(target.name)
+        alive = [h for h in hosts if h.name not in dead]
+        for h in alive:
+            h.tick()
+        # busy is re-checked AFTER the full round: an exported sequence
+        # sits in the transport for one round before the peer's recv
+        # absorbs it, so a single idle snapshot mid-round lies
+        busy = any(h.busy for h in alive)
+        finished = {
+            r.rid for h in hosts for r in h.sched.finished if r.rid >= 0
+        }
+        if finished >= rids and not pending:
+            break
+        idle_rounds = 0 if busy else idle_rounds + 1
+        if idle_rounds >= 4 and not pending:
+            raise RuntimeError(
+                "fleet stalled with requests unfinished: "
+                f"{sorted(rids - finished)}"
+            )
+        if not busy and pending:
+            time.sleep(min(max(pending[0][0] - now, 0.0), 0.01))
+        tick += 1
+    elapsed = time.perf_counter() - t0
+    streams = {
+        r.rid: list(r.tokens)
+        for h in hosts for r in h.sched.finished if r.rid >= 0
+    }
+    lat_ms = sorted(
+        (r.finish_mono - r.enqueue_mono) * 1e3
+        for h in hosts for r in h.sched.finished if r.rid >= 0
+    )
+    return hosts, router, elapsed, streams, lat_ms, acct
+
+
+def _fleet_main(args, params, cfg, prompts) -> int:
+    """The --fleet drill: role-split hosts behind the front-door
+    router vs ONE unified host at the same per-host slots (which is
+    also the token oracle — scheduling, routing, and migration may
+    never move a token). Reports per-host occupancy + queue-inclusive
+    p50/p99; with --sigterm_at_tick/--sigterm_host, the drain-to-peer
+    drill (exit 75, streams still identical)."""
+    from ..resilience.preemption import EXIT_RESUMABLE
+
+    n_hosts = len([r for r in args.fleet_hosts.split(",") if r.strip()])
+    recorders = router_rec = None
+    if args.workspace:
+        import os
+
+        from ..obs.recorder import FlightRecorder
+
+        events = os.path.join(args.workspace, "events")
+        recorders = [
+            FlightRecorder(events, rank=i, run_id="serve_bench_fleet")
+            for i in range(n_hosts)
+        ]
+        router_rec = FlightRecorder(
+            events, rank=n_hosts, run_id="serve_bench_fleet"
+        )
+        router_rec.event("run_start", step=0, mode="serve_bench_fleet")
+    # the single unified host: the number the fleet must beat AND the
+    # token oracle it must match
+    base_sched, base_s, _ = run_continuous(
+        params, cfg, prompts, args, slots=args.concurrency,
+        spec_k=args.speculate_k, prefix_cache=args.prefix_cache,
+        kernels=args.kernels,
+    )
+    base = {r.rid: list(r.tokens) for r in base_sched.finished}
+    base_tokens = base_sched.tokens_emitted + len(base_sched.finished)
+    hosts, router, elapsed, streams, lat_ms, acct = run_fleet(
+        params, cfg, prompts, args,
+        recorders=recorders, router_recorder=router_rec,
+        sigterm_at_tick=args.sigterm_at_tick,
+        sigterm_target=args.sigterm_host,
+    )
+    drill = bool(args.sigterm_at_tick)
+    tokens = sum(len(t) for t in streams.values())
+    mismatches = sum(
+        1 for i in base if streams.get(i) != base[i]
+    )
+    decode_prefill_chunks = sum(
+        h.sched.prefill_chunks for h in hosts if h.role == "decode"
+    )
+    migrations = sum(h.migrate_in for h in hosts)
+    out = {
+        "fleet": True,
+        "fleet_hosts": args.fleet_hosts,
+        "concurrency": args.concurrency,
+        "requests": len(prompts),
+        "finished": len(streams),
+        "tokens": tokens,
+        "serve_s": round(elapsed, 4),
+        "tokens_per_s": round(tokens / elapsed, 1) if elapsed > 0 else 0.0,
+        "single_tokens_per_s": round(base_tokens / base_s, 1)
+        if base_s > 0 else 0.0,
+        # queue-INCLUSIVE (front-door submit -> finish, wherever the
+        # sequence finished) latency across every host
+        "p50_ms": round(_percentile(lat_ms, 0.50), 2),
+        "p99_ms": round(_percentile(lat_ms, 0.99), 2),
+        "hosts": {
+            h.name: {
+                "role": h.role,
+                "migrate_in": h.migrate_in,
+                "migrate_out": h.migrate_out,
+                "blocks_in": h.blocks_in,
+                "blocks_out": h.blocks_out,
+                "prefill_chunks": h.sched.prefill_chunks,
+                **h.sched.occupancy(),
+            }
+            for h in hosts
+        },
+        "migrations": migrations,
+        "routed": router.routed,
+        "affinity_hits": router.affinity_hits,
+        "token_mismatches": mismatches,
+        "decode_prefill_chunks": decode_prefill_chunks,
+        "fleet_threshold": args.fleet_threshold,
+    }
+    out["fleet_speedup"] = (
+        round(out["tokens_per_s"] / out["single_tokens_per_s"], 3)
+        if out["single_tokens_per_s"] else None
+    )
+    has_decode = any(h.role == "decode" for h in hosts)
+    # or-gate (the stall tools' pattern): the end-to-end speedup
+    # carries on accelerator hosts, where N fleet hosts ARE N chips'
+    # worth of decode bandwidth; on CPU CI every "host" shares the
+    # same cores, so the deterministic arm carries — the role split
+    # PROVED (decode hosts executed zero prefill chunks while >= 1
+    # migrated sequence actually streamed through them). Tokens must
+    # match the single host either way.
+    out["pass_mode"] = (
+        "end_to_end"
+        if (out["fleet_speedup"] or 0) >= args.fleet_threshold
+        else "role_split"
+        if has_decode and decode_prefill_chunks == 0 and migrations > 0
+        else None
+    )
+    out["pass"] = mismatches == 0 and out["pass_mode"] is not None
+    if drill:
+        out["drained"] = acct is not None
+        if acct is not None:
+            out["drain"] = acct
+    if recorders:
+        for i, rec in enumerate(recorders):
+            rec.event(
+                "run_stop", step=hosts[i].sched.ticks,
+                exit_code=EXIT_RESUMABLE if drill and acct else 0,
+            )
+            rec.close()
+        router_rec.close()
+    print(json.dumps(out))
+    if drill:
+        return EXIT_RESUMABLE if acct is not None and out["pass"] else 1
+    if args.no_gate:
+        return 0
+    return 0 if out["pass"] else 1
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     import jax
@@ -453,6 +774,11 @@ def main(argv=None) -> int:
     )
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
     prompts = _workload(args)
+
+    if args.fleet:
+        # the disaggregated-fleet drill owns its whole flow (its own
+        # per-host recorders, baseline, gate, and drain drill)
+        return _fleet_main(args, params, cfg, prompts)
 
     recorder = None
     if args.workspace:

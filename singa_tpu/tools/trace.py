@@ -304,6 +304,44 @@ def summarize(records: list[dict]) -> dict:
         int(d.get("chunks_saved", 0)) for d in prefix_hit_events
     )
 
+    # fleet: per-host roles from the run-start fleet_role events, and
+    # block-migration volume from migrate_in (counted on the importer,
+    # where the blocks actually landed; migrate_out double-counts a
+    # drain-to-peer re-migration)
+    fleet_roles: dict[int, str] = {}
+    for r in life:
+        if r.get("kind") == "fleet_role" and isinstance(r.get("data"), dict):
+            fleet_roles[int(r.get("rank", 0))] = r["data"].get("role")
+    migrate_in_events = [
+        r for r in life
+        if r.get("kind") == "migrate_in" and isinstance(r.get("data"), dict)
+    ]
+    migrated_blocks = sum(
+        int(r["data"].get("blocks", 0)) for r in migrate_in_events
+    )
+    hosts: dict[str, dict] = {}
+    if fleet_roles:
+        per_rank: dict[int, dict[str, int]] = {}
+        for r in life:
+            rank = int(r.get("rank", 0))
+            if rank not in fleet_roles:
+                continue
+            per_rank.setdefault(rank, {})
+            k = r.get("kind", "?")
+            per_rank[rank][k] = per_rank[rank].get(k, 0) + 1
+        for rank in sorted(fleet_roles):
+            c = per_rank.get(rank, {})
+            hosts[str(rank)] = {
+                "role": fleet_roles[rank],
+                "admitted": c.get("request_admit", 0),
+                "prefill_chunks": c.get("prefill", 0),
+                "migrate_in": c.get("migrate_in", 0),
+                "migrate_out": c.get("migrate_out", 0),
+                "retired": c.get("retire", 0),
+                "evicted": c.get("evict", 0),
+                "drains": c.get("drain", 0),
+            }
+
     faults = [
         r["data"].get("fault")
         for r in life
@@ -430,8 +468,19 @@ def summarize(records: list[dict]) -> dict:
             "retired": counts.get("retire", 0),
             "evicted": counts.get("evict", 0),
             "backpressure": counts.get("backpressure", 0),
+            # fleet (zero / empty without fleet events in the log):
+            # cross-host sequence migrations, the block volume they
+            # moved, front-door placements, and per-role host rows
+            # keyed by rank from the cross-rank merge
+            "migrations": len(migrate_in_events),
+            "migrated_blocks": migrated_blocks,
+            "routed": counts.get("route", 0),
+            "hosts": hosts or None,
         }
-        if (request_ms or ticks or counts.get("request_admit"))
+        if (
+            request_ms or ticks or counts.get("request_admit")
+            or fleet_roles or counts.get("route")
+        )
         else None,
     }
 

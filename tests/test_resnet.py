@@ -278,7 +278,18 @@ def test_bn_normalizes_batch(shard):
     )
     bn = np.asarray(acts["bn"])
     np.testing.assert_allclose(bn.mean(axis=0), 0.0, atol=1e-4)
-    np.testing.assert_allclose(bn.std(axis=0), 1.0, atol=1e-2)
+    # the normalizer divides by sqrt(var + eps), so a channel whose
+    # activation variance is within a couple orders of magnitude of
+    # eps=1e-5 lands measurably BELOW unit std (var 2e-4 -> std 0.977
+    # — exactly what this net's smallest fc1 channels produce; the old
+    # flat `std == 1 +- 1e-2` assert flickered with jax/thread-count
+    # reduction details shifting those tiny variances). Assert the
+    # exact eps-aware expectation per channel, plus a loose sanity
+    # band that the output is still ~unit scale.
+    fc1 = np.asarray(acts["fc1"])
+    want_std = fc1.std(axis=0) / np.sqrt(fc1.var(axis=0) + 1e-5)
+    np.testing.assert_allclose(bn.std(axis=0), want_std, atol=1e-3)
+    np.testing.assert_allclose(bn.std(axis=0), 1.0, atol=5e-2)
 
 
 def test_bn_buffers_track_running_stats(shard):
