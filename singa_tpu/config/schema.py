@@ -612,6 +612,17 @@ class ResilienceConfig(Message):
         # window resets the breaker); 0 = never restart
         "max_restarts": Field("int", 3),
         "restart_window_steps": Field("int", 1),
+        # --- launcher-side restart budget (resilience/launcher.py) ---
+        # distinct from the in-process breaker above: the breaker bounds
+        # crash loops WITHIN one process lifetime, while exit-75
+        # (resumable) statuses deliberately bypass it — a launcher that
+        # blindly relaunches them can loop forever on a deterministic
+        # drain/death cycle. The elastic launcher relaunches a gang at
+        # most max_restarts_per_window times per rolling
+        # restart_window_s seconds, then gives up loudly.
+        # 0 = unbudgeted (relaunch forever; today's behavior).
+        "max_restarts_per_window": Field("int", 0),
+        "restart_window_s": Field("float", 3600.0),
         # exponential backoff between restarts: base * 2^k seconds,
         # capped at backoff_max (tests set base 0 for instant retries)
         "backoff_base": Field("float", 1.0),
@@ -818,6 +829,17 @@ class FleetConfig(Message):
         "prefill_hosts": Field("int", 1),
         # shared mailbox-transport root ("" = <workspace>/fleet)
         "mailbox": Field("string", ""),
+        # --- elastic fleet sizing (serve/fleet/host.py): the topology
+        # (peers / nworkers) declares up to max_hosts ranks, but only
+        # ranks [0, min_hosts) must be live at launch — the rest are
+        # LATENT: declared, excluded from every placement decision
+        # until they JOIN by publishing a serving status through the
+        # transport (at which point prefill hosts start exporting to
+        # them and the router sees their occupancy). Scale-down is the
+        # drain-to-peer path (tombstone). 0 = the whole topology is
+        # live at launch (the fixed fleet; today's behavior). ---
+        "min_hosts": Field("int", 0),
+        "max_hosts": Field("int", 0),
     }
 
 

@@ -29,6 +29,7 @@ from .core import (  # noqa: F401
     render_text,
 )
 from .net_rules import (  # noqa: F401
+    elastic_rules,
     engine_rules,
     lint_cluster_text,
     lint_model_text,

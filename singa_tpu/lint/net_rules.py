@@ -93,6 +93,14 @@ KRN002 = rule(
     "non-data mesh axis, with a batch-stat (kBatchNorm) net, or with "
     "the replica engine",
 )
+ELA001 = rule(
+    "ELA001",
+    ERROR,
+    "resume checkpoint's sharded manifest cannot be hosted by the "
+    "--cluster mesh (a spec names an axis the mesh lacks, or a dim "
+    "has fewer elements than the target axis width — beyond even the "
+    "pad/replicate fallback)",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -478,6 +486,76 @@ def fleet_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
     fleet = getattr(model_cfg, "fleet", None)
     if fleet is None:
         return
+    # (c) elastic sizing that cannot describe a fleet. Explicit peers
+    # entries ARE the topology, so max_hosts cannot invent hosts beyond
+    # them, and min_hosts cannot exceed whatever is actually declared
+    # (peers when present, else max_hosts) — both reject at
+    # run_from_conf before any host serves
+    if (
+        fleet.peers
+        and fleet.max_hosts
+        and fleet.max_hosts > len(fleet.peers)
+    ):
+        col.emit(
+            FLT001,
+            path,
+            f"fleet max_hosts {fleet.max_hosts} exceeds the "
+            f"{len(fleet.peers)} declared peers entries — peers name "
+            "the whole topology, max_hosts cannot invent hosts: the "
+            "launch would reject before any host serves",
+            fix_hint="declare the extra hosts as peers entries, or "
+            "drop max_hosts",
+        )
+    n_declared = len(fleet.peers or ()) or (fleet.max_hosts or 0)
+    if fleet.min_hosts and n_declared and fleet.min_hosts > n_declared:
+        col.emit(
+            FLT001,
+            path,
+            f"fleet min_hosts {fleet.min_hosts} exceeds the declared "
+            f"topology ({n_declared} host(s) from "
+            f"{'peers' if fleet.peers else 'max_hosts'}): the launch "
+            "would reject before any host serves",
+            fix_hint="lower min_hosts or declare more peers/max_hosts",
+        )
+    # (d) a LIVE prefix [0, min_hosts) that covers only one half of a
+    # split-role fleet: latent peers are excluded from placement until
+    # they join, so the lonely live half either rejects at FleetHost
+    # construction (decode with no live prefill) or silently defers
+    # every filled sequence forever (prefill with no live decode).
+    # Statically decidable with explicit peers, or with role auto's
+    # rank-split (ranks below prefill_hosts prefill, the rest decode).
+    live_prefix: list[str] | None = None
+    if fleet.min_hosts:
+        if fleet.peers and fleet.min_hosts <= len(fleet.peers):
+            live_prefix = [
+                p.role for p in fleet.peers[: fleet.min_hosts]
+            ]
+        elif not fleet.peers and fleet.role == "auto":
+            np_hosts = max(1, fleet.prefill_hosts)
+            live_prefix = [
+                "prefill" if k < np_hosts else "decode"
+                for k in range(fleet.min_hosts)
+            ]
+    if live_prefix is not None:
+        live = set(live_prefix)
+        for lonely, need in (
+            ("prefill", {"decode", "unified"}),
+            ("decode", {"prefill", "unified"}),
+        ):
+            if lonely in live and not live & need:
+                col.emit(
+                    FLT001,
+                    path,
+                    f"fleet live prefix [0, min_hosts={fleet.min_hosts}) "
+                    f"is {lonely}-only — the "
+                    f"{'/'.join(sorted(need))} half is entirely LATENT "
+                    "(excluded from placement until it joins), so the "
+                    "fleet launches but cannot serve a single stream "
+                    "until a join happens",
+                    fix_hint="raise min_hosts to cover both roles, or "
+                    "reorder peers so the live prefix is "
+                    "self-sufficient",
+                )
     peer_roles = [p.role for p in (fleet.peers or [])]
     if peer_roles:
         topo_roles = set(peer_roles)
@@ -528,6 +606,67 @@ def fleet_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
             "config at construction",
             fix_hint="add a peers { name: ... role: decode } entry, "
             "or run role: unified",
+        )
+
+
+def elastic_rules(
+    model_cfg: ModelConfig,
+    widths: dict[str, int] | None,
+    path: str,
+    col: Collector,
+) -> None:
+    """ELA001 — static mirror of the elastic-restore admission check
+    (resilience/reshard.py ``check_manifest``; threaded through
+    ``--cluster`` like SRV001/KRN002). When the conf's ``checkpoint``
+    field names a SHARDED checkpoint dir whose manifest is readable,
+    every saved entry's recorded PartitionSpec must be hostable by the
+    target cluster's mesh: a spec naming an axis the mesh vocabulary
+    lacks (a foreign manifest), or a dim with fewer elements than the
+    named axes' combined target width wants shards (beyond even the
+    pad/replicate fallback), rejects at restore time — after the pod
+    is already up. The SAME ``hostable`` predicate runs here, so lint
+    and runtime can never disagree. A checkpoint path that does not
+    exist (yet) or is an npz file is skipped: only a present, parseable
+    manifest is statically decidable, like SRV001's window."""
+    import json
+    import os
+
+    if widths is None:
+        return
+    ckpt = getattr(model_cfg, "checkpoint", None)
+    if not ckpt or not os.path.isdir(ckpt):
+        return
+    try:
+        with open(os.path.join(ckpt, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return  # not a readable sharded manifest: nothing decidable
+    if manifest.get("format") != "singa-tpu-sharded-v1":
+        # the runtime never feeds a foreign-format manifest to the
+        # resharder (ShardedCheckpoint rejects it first) — lint must
+        # not claim a reshard verdict the runtime would never reach
+        return
+    from ..resilience.reshard import check_manifest
+
+    problems = check_manifest(manifest, widths)
+    # one diagnostic per distinct reason, naming one exemplar entry —
+    # a 200-param model sharing one bad axis is ONE problem
+    by_reason: dict[str, str] = {}
+    for key in sorted(problems):
+        by_reason.setdefault(problems[key], key)
+    for reason, key in by_reason.items():
+        more = sum(1 for r in problems.values() if r == reason) - 1
+        extra = f" (+{more} more entr{'y' if more == 1 else 'ies'})" \
+            if more else ""
+        col.emit(
+            ELA001,
+            path,
+            f"checkpoint {ckpt!r} entry {key!r}{extra}: {reason} — "
+            "the elastic restore would reject this resume at runtime "
+            "(resilience/reshard.py)",
+            fix_hint="resume on a mesh whose axis widths can host the "
+            "manifest's specs, or point `checkpoint` at a compatible "
+            "save",
         )
 
 

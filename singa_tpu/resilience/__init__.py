@@ -28,6 +28,14 @@ obligation moves into this trainer-side resilience layer:
                   + per-rank heartbeat files with a peer-liveness
                   deadline — a dead peer turns a forever-hung
                   collective into a loud resumable exit
+  reshard.py      elastic restore: reshard an N-process sharded
+                  checkpoint onto M ranks (box-intersection re-slicing
+                  per target shard; the ``hostable`` mesh-admission
+                  check netlint ELA001 mirrors)
+  launcher.py     launcher-side restart budget (resumable exits bypass
+                  the in-process breaker by design; the budget bounds
+                  gang relaunches per rolling window) + the elastic
+                  gang-relaunch loop behind tools/elastic_launch.py
   faults.py       the deterministic fault plan (``crash@7,...``, with
                   an optional ``:rank=K`` target) that lets tests
                   PROVE end-to-end recovery
@@ -66,6 +74,18 @@ from .preemption import (  # noqa: F401
     EXIT_RESUMABLE,
     PreemptionDrained,
     PreemptionHandler,
+)
+from .launcher import (  # noqa: F401
+    RestartBudget,
+    gang_verdict,
+    supervise_gang,
+)
+from .reshard import (  # noqa: F401
+    Resharder,
+    ReshardError,
+    check_manifest,
+    checkpoint_nprocs,
+    hostable,
 )
 from .retention import (  # noqa: F401
     LATEST_MARKER,

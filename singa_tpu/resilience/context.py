@@ -431,6 +431,16 @@ class ResilienceContext:
             self.log(
                 f"FAULT: corrupt_ckpt@{self.save_ordinal} — tore {path}"
             )
+        spec = self.plan.fire("torn_sidecar", self.save_ordinal)
+        if spec is not None:
+            # the replica .server sidecar beside the save (written just
+            # before this hook): tear IT, not the shards — validation
+            # must reject the whole save on the sidecar alone
+            tear_file(path + ".server")
+            self.log(
+                f"FAULT: torn_sidecar@{self.save_ordinal} — tore "
+                f"{path}.server"
+            )
         rec = self.recorder
         if rec is not None:
             # every rank records its own write (async path: from the

@@ -175,6 +175,63 @@ def commit_ok(path: str, proc: int) -> bool:
         return False
 
 
+def sidecar_path(path: str) -> str:
+    """The replica engine's ``.server`` sidecar beside checkpoint
+    ``path`` (center + protocol snapshot, trainer/replica.py)."""
+    return path + ".server"
+
+
+def sidecar_marker_path(path: str) -> str:
+    """``commit_server.json`` INSIDE sharded checkpoint dir ``path`` —
+    the sidecar's commit marker. Living inside the dir means retention
+    fingerprints cover it and rmtree removes it with the save."""
+    return os.path.join(path, "commit_server.json")
+
+
+def write_sidecar_commit(path: str) -> str:
+    """Publish the commit marker for the ``.server`` sidecar the
+    replica engine just wrote beside sharded dir ``path`` (the same
+    size+CRC32 vouching as the per-proc markers, atomic tmp+rename).
+    Written AFTER the sidecar, by the one rank that writes sidecars
+    (rank 0): marker present => sidecar fully written."""
+    marker = {
+        "format": COMMIT_FORMAT,
+        "sidecar": True,
+        **shard_digest(sidecar_path(path)),
+    }
+    return atomic_write_bytes(
+        sidecar_marker_path(path),
+        json.dumps(marker).encode("utf-8"),
+    )
+
+
+def sidecar_commit_ok(path: str) -> bool:
+    """True iff sharded dir ``path``'s ``.server`` sidecar exists and
+    matches its commit marker's size + CRC32. A torn sidecar, a torn
+    marker, or a rank that died between sidecar and marker all fail —
+    a committed shard save can never pair with a half-written protocol
+    sidecar (retention._sharded_valid enforces this whenever the
+    manifest promises a sidecar)."""
+    try:
+        with open(sidecar_marker_path(path), encoding="utf-8") as f:
+            marker = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if marker.get("format") != COMMIT_FORMAT or not marker.get("sidecar"):
+        return False
+    try:
+        digest = shard_digest(sidecar_path(path))
+    except OSError:
+        return False
+    try:
+        return (
+            int(marker["size"]) == digest["size"]
+            and int(marker["crc32"]) == digest["crc32"]
+        )
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
 def await_commits(
     path: str, timeout: float = 60.0, log=print, poll: float = 0.05
 ) -> bool:

@@ -13,6 +13,10 @@ from the ``-faults`` CLI flag or the ``SINGA_TPU_FAULTS`` env var:
   corrupt_ckpt@1   truncate the 1st checkpoint written (ordinal, 1-based,
                    between the save and the LATEST mark) — exercises
                    torn-save detection in the retention module
+  torn_sidecar@1   truncate the replica engine's ``.server`` sidecar of
+                   the 1st checkpoint written (same ordinal keying) —
+                   exercises the sidecar commit markers: a save whose
+                   protocol sidecar tore must never become LATEST
   slowstep@9=0.5   sleep 0.5 s at the step-9 boundary — exercises the
                    step-wall-clock watchdog
   async_torn_write@1  tear the 1st ASYNC checkpoint write (ordinal,
@@ -78,6 +82,7 @@ KINDS = (
     "sigterm",
     "nanloss",
     "corrupt_ckpt",
+    "torn_sidecar",
     "slowstep",
     "async_torn_write",
     "profile",

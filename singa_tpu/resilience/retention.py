@@ -58,7 +58,10 @@ def checkpoint_step(path: str) -> int | None:
 def _npz_valid(path: str) -> bool:
     """Intact zip archive holding the ``__step__`` entry. ``testzip``
     CRC-checks every member, so a truncated or bit-flipped save fails
-    even though np.load's lazy zip layer might open it."""
+    even though np.load's lazy zip layer might open it. (The replica
+    ``.server`` sidecar is only commit-verified for SHARDED saves —
+    ``_sharded_valid`` + the manifest's ``sidecar`` promise; the npz
+    format has no commit machinery to ride.)"""
     try:
         with zipfile.ZipFile(path) as z:
             if z.testzip() is not None:
@@ -73,13 +76,20 @@ def _sharded_valid(path: str) -> bool:
     — for saves written under the two-phase commit protocol — every
     per-proc commit marker matches its shard's bytes. A save missing
     even one peer's commit (rank died between shard and marker, or the
-    marker itself was torn) is NOT a checkpoint."""
+    marker itself was torn) is NOT a checkpoint. A manifest that
+    promises a replica ``.server`` sidecar (``"sidecar": true``,
+    trainer/replica.py) additionally needs the sidecar file AND its
+    ``commit_server.json`` marker to match — a rank that died between
+    shard commit and sidecar, or tore the sidecar afterwards, must not
+    leave a resumable-looking save whose protocol state is garbage."""
     try:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
     except (OSError, ValueError):
         return False
     if manifest.get("format") != "singa-tpu-sharded-v1":
+        return False
+    if manifest.get("sidecar") and not coord.sidecar_commit_ok(path):
         return False
     nprocs = int(manifest.get("nprocs", 1))
     committed = manifest.get("commit") == coord.COMMIT_VERSION
@@ -125,12 +135,21 @@ def _fingerprint(path: str) -> tuple | None:
             names = ["manifest.json"] + sorted(
                 f
                 for f in os.listdir(path)
-                if _PROC_RE.match(f) or _COMMIT_RE.match(f)
+                if _PROC_RE.match(f)
+                or _COMMIT_RE.match(f)
+                or f == "commit_server.json"
             )
             fp = []
             for name in names:
                 st = os.stat(os.path.join(path, name))
                 fp.append((name, st.st_mtime_ns, st.st_size))
+            # the replica .server sidecar lives BESIDE the dir; a tear
+            # of it must invalidate the cached verdict too
+            try:
+                st = os.stat(path + ".server")
+                fp.append((".server", st.st_mtime_ns, st.st_size))
+            except OSError:
+                pass
             return tuple(fp)
         st = os.stat(path)
         return (st.st_mtime_ns, st.st_size)

@@ -152,6 +152,31 @@ def run(
             # back to the one before it (retention.resolve_latest)
             latest = retention.resolve_latest(ckpt_dir)
             model_cfg.checkpoint = latest or configured_ckpt
+            # elastic restore: a sharded save written by a DIFFERENT
+            # world size is not an error — the trainer reshards it onto
+            # this topology (resilience/reshard.py). Announce it here,
+            # before the rebuild, so a post-mortem can see the N->M
+            # transition even if the restore itself then fails
+            if model_cfg.checkpoint:
+                from .coord import process_count
+                from .reshard import checkpoint_nprocs
+
+                saved_np = checkpoint_nprocs(model_cfg.checkpoint)
+                if saved_np is not None and saved_np != process_count():
+                    log(
+                        f"supervisor: elastic restore — "
+                        f"{model_cfg.checkpoint} was written by "
+                        f"{saved_np} process(es), this job runs "
+                        f"{process_count()}; resharding on load"
+                    )
+                    if rec is not None:
+                        rec.event(
+                            "reshard",
+                            checkpoint=model_cfg.checkpoint,
+                            saved_nprocs=saved_np,
+                            nprocs=process_count(),
+                            attempt=attempt,
+                        )
             trainer = None
             try:
                 trainer = trainer_factory(

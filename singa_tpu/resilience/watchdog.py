@@ -22,6 +22,18 @@ forever-hung collective becomes a loud, launcher-restartable event. A
 rank that exits deliberately (trained to completion, or a coordinated
 preemption drain) publishes a ``rank_k.done`` sentinel first, so its
 now-frozen heartbeat is never mistaken for a death.
+
+Staleness is judged by TWO signals, either of which proves life: the
+file's mtime, and a monotonic beat counter written into the file body.
+The counter exists for workspaces on object-store/NFS mounts whose
+mtimes are coarse (second granularity), cached, or clock-skewed across
+hosts — there a perfectly healthy peer's mtime can read stale for
+longer than a tight deadline, and mtime alone would false-positive
+``peer_death`` and kill a live job. A counter that ADVANCES between our
+polls restarts the peer's staleness clock locally (observer-side
+monotonic time, no cross-host clock comparison at all); a frozen
+counter leaves the verdict to the mtime-vs-arming math exactly as
+before, so bodiless heartbeat files from older runs still work.
 """
 
 from __future__ import annotations
@@ -37,6 +49,18 @@ from .preemption import EXIT_RESUMABLE
 
 def heartbeat_file(directory: str, rank: int) -> str:
     return os.path.join(directory, f"rank_{rank}.hb")
+
+
+def read_heartbeat_counter(path: str) -> int | None:
+    """The monotonic beat counter in a heartbeat file's body, or None
+    (absent file, empty/foreign body — e.g. a pre-counter run's
+    touch-only file). Never raises: liveness must degrade to the mtime
+    signal, not crash the watch thread."""
+    try:
+        with open(path, "rb") as f:
+            return int(f.read(32).split(b"\n", 1)[0])
+    except (OSError, ValueError):
+        return None
 
 
 def done_file(directory: str, rank: int) -> str:
@@ -72,6 +96,16 @@ class Watchdog:
         #: peers this instance declared dead (tests read it; also keeps
         #: a non-exiting on_peer_dead callback from firing per poll)
         self.dead_peers: set[int] = set()
+        #: our own monotonic beat counter (heartbeat file body)
+        self._beat_seq = 0
+        #: peer rank -> (last counter seen, staleness clock, last time
+        #: WE looked): the observer-side staleness clock that makes
+        #: peer liveness survive coarse-mtime filesystems. The
+        #: last-look stamp distinguishes "counter advanced since a
+        #: poll moments ago" (alive) from "counter differs from an
+        #: observation made during a stall episode hours back" (no
+        #: evidence either way — start fresh)
+        self._peer_seen: dict[int, tuple[int, float, float]] = {}
 
     def enable_heartbeats(
         self,
@@ -179,9 +213,20 @@ class Watchdog:
     def _touch_heartbeat(self) -> None:
         hb = self._hb
         path = heartbeat_file(hb["dir"], hb["rank"])
+        self._beat_seq += 1
         try:
-            with open(path, "a"):
-                pass
+            # mtime AND a monotonic counter in the body: coarse-mtime
+            # mounts (object store / NFS) get their liveness from the
+            # advancing counter. Published atomically (tmp + rename,
+            # the coord-plane primitive): a truncate-then-write here
+            # would hand a racing reader an EMPTY body — and on exactly
+            # the coarse-mtime mounts the counter exists for, "fall
+            # back to mtime" IS the false-positive death verdict.
+            from .coord import atomic_write_bytes
+
+            atomic_write_bytes(
+                path, f"{self._beat_seq}\n".encode("ascii")
+            )
             os.utime(path, None)
         except OSError:
             pass  # a flaky shared FS must not kill the watchdog thread
@@ -197,15 +242,53 @@ class Watchdog:
         """Our own step is stalled past the peer deadline — are we stuck
         because a peer process died mid-collective?"""
         now = time.time()
+        now_mono = time.monotonic()
         for k in range(hb["nprocs"]):
             if k == hb["rank"] or k in self.dead_peers:
                 continue
-            hb_m = self._mtime(heartbeat_file(hb["dir"], k))
+            peer_path = heartbeat_file(hb["dir"], k)
+            hb_m = self._mtime(peer_path)
             # grace from arming: a peer that has not beaten yet is
             # (still) initializing, not dead
             age = now - max(hb_m or 0.0, hb["enabled_at"])
             if age <= hb["timeout"]:
                 continue
+            # second signal: the body's beat counter. An mtime stale
+            # past the deadline on a coarse-mtime mount says nothing if
+            # the counter is still advancing — restart the staleness
+            # clock from OUR OWN monotonic observation of the change
+            # (no cross-host clock enters the verdict). The FIRST
+            # observation is backdated to two polls short of the
+            # deadline: a live peer gets two polls to demonstrate an
+            # advancing counter, while a genuinely dead peer's verdict
+            # lands ~two polls after the mtime deadline — not a whole
+            # extra timeout of silent hang.
+            seq = read_heartbeat_counter(peer_path)
+            if seq is not None:
+                last = self._peer_seen.get(k)
+                if last is None or now_mono - last[2] > hb["timeout"]:
+                    # first look — or our last look predates this
+                    # stall episode (_check_peers only runs while WE
+                    # are stalled), so a differing counter would say
+                    # nothing about the peer's recent liveness. Start
+                    # a fresh clock, backdated to two polls short of
+                    # the deadline: the peer beats at the same
+                    # ~timeout/4 cadence we poll at, so a live one
+                    # gets two observation gaps to demonstrate an
+                    # advancing counter while a dead one's verdict
+                    # lands ~two polls later — not a whole extra
+                    # timeout of silent hang
+                    grace = 2.0 * self._poll_interval()
+                    self._peer_seen[k] = (
+                        seq, now_mono - hb["timeout"] + grace, now_mono
+                    )
+                    continue
+                if last[0] != seq:
+                    self._peer_seen[k] = (seq, now_mono, now_mono)
+                    continue  # advanced since our last look: alive
+                self._peer_seen[k] = (seq, last[1], now_mono)
+                if now_mono - last[1] <= hb["timeout"]:
+                    continue  # changed recently enough: alive
             done_m = self._mtime(done_file(hb["dir"], k))
             deliberate = (
                 done_m is not None

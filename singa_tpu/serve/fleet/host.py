@@ -92,10 +92,21 @@ class FleetHost:
     migration/forwarding glue. ``peers`` maps every OTHER host's name
     to its role (the static topology); live placement reads the
     transport's status feedback and falls back to the static map while
-    a peer has not published yet."""
+    a peer has not published yet.
+
+    ``latent`` names the ELASTIC slice of the topology (``fleet {
+    min_hosts / max_hosts }``): peers that are declared but not
+    launched yet. A latent peer gets NO static-fallback placements —
+    exporting a sequence to a host that may never start would strand
+    it — until it JOINS by publishing a serving status (its announce;
+    the observer logs a ``fleet_join`` event and starts placing onto
+    it). Leaving is the existing drain-to-peer path: the tombstone
+    status takes the host out of every candidate set, and re-joining
+    is just publishing a serving status again."""
 
     def __init__(self, name: str, role: str, engine: Engine, transport,
-                 *, peers: dict[str, str] | None = None, recorder=None,
+                 *, peers: dict[str, str] | None = None,
+                 latent: set[str] | None = None, recorder=None,
                  preemption=None, results_to: str | None = None,
                  log=lambda s: None):
         if role not in ROLES:
@@ -106,27 +117,37 @@ class FleetHost:
         self.engine = engine
         self.transport = transport
         self.peers = dict(peers or {})
+        #: declared-but-not-yet-joined peers (elastic fleet): no
+        #: static-fallback placements until they publish a status
+        self._latent = set(latent or ()) & set(self.peers)
         self.results_to = results_to
         self.preemption = preemption
         self.log = log
         # the runtime half of netlint FLT001: a split-role host with no
         # peer for the other half can never finish (or never start) a
-        # stream — reject at construction, before any request is taken
+        # stream — reject at construction, before any request is taken.
+        # LATENT peers don't count: a capable peer that may never
+        # launch is not a counterpart — the live fleet must cover both
+        # halves on its own
+        live_roles = [
+            r for n, r in self.peers.items() if n not in self._latent
+        ]
         if role == "decode" and not any(
-            r in PREFILL_CAPABLE for r in self.peers.values()
+            r in PREFILL_CAPABLE for r in live_roles
         ):
             raise ValueError(
-                f"decode-role host {name!r} has no prefill-capable peer: "
-                "nothing can ever fill its KV blocks (netlint FLT001 "
-                "flags this statically)"
+                f"decode-role host {name!r} has no prefill-capable peer "
+                "among live (non-latent) hosts: nothing can ever fill "
+                "its KV blocks (netlint FLT001 flags this statically)"
             )
         if role == "prefill" and not any(
-            r in DECODE_CAPABLE for r in self.peers.values()
+            r in DECODE_CAPABLE for r in live_roles
         ):
             raise ValueError(
-                f"prefill-role host {name!r} has no decode-capable peer: "
-                "filled sequences would have nowhere to stream (netlint "
-                "FLT001 flags this statically)"
+                f"prefill-role host {name!r} has no decode-capable peer "
+                "among live (non-latent) hosts: filled sequences would "
+                "have nowhere to stream (netlint FLT001 flags this "
+                "statically)"
             )
         self.sched = Scheduler(
             engine, recorder=recorder, preemption=preemption, log=log,
@@ -176,15 +197,18 @@ class FleetHost:
     def _peer_snapshots(self, roles, exclude: str | None = None):
         """Published statuses of capable peers, least-loaded first;
         peers that have never published ride at the end on their
-        static-topology role (boot window). A peer whose PUBLISHED
-        role fell out of ``roles`` is excluded outright — that is how
-        a drained host's tombstone (role "drained") takes it out of
-        every placement decision."""
+        static-topology role (boot window) — EXCEPT latent (elastic,
+        not-yet-launched) peers, which join the candidate set only once
+        they have announced themselves by publishing. A peer whose
+        PUBLISHED role fell out of ``roles`` is excluded outright —
+        that is how a drained host's tombstone (role "drained") takes
+        it out of every placement decision."""
         published = {
             s.get("host"): s
             for s in self.transport.statuses().values()
             if s.get("host") in self.peers
         }
+        self._note_joins(published)
         out = [
             s for h, s in published.items()
             if s.get("role") in roles and h != exclude
@@ -194,8 +218,32 @@ class FleetHost:
             {"host": n, "role": r}
             for n, r in sorted(self.peers.items())
             if r in roles and n not in published and n != exclude
+            and n not in self._latent
         )
         return out
+
+    def _note_joins(self, published: dict) -> None:
+        """A latent peer that published a serving status has JOINED the
+        fleet: admit it to placement and record the scale event (once
+        per join — a later tombstone re-latents it, so a re-join is
+        observable too)."""
+        for h, s in published.items():
+            role = s.get("role")
+            if h in self._latent and role in ROLES:
+                self._latent.discard(h)
+                self._event("fleet_join", host=h, role=role)
+                self.log(f"fleet host {self.name}: peer {h!r} joined "
+                         f"as {role}")
+            elif h not in self._latent and role == "drained" and (
+                h in self.peers
+            ):
+                # a drained peer is latent again: placements stop (the
+                # tombstone already guarantees that) AND a future
+                # serving status counts as a fresh join event
+                self._latent.add(h)
+                self._event("fleet_leave", host=h)
+                self.log(f"fleet host {self.name}: peer {h!r} left "
+                         "(drained)")
 
     def _pick_peer(self, roles, exclude: str | None = None) -> str | None:
         """Least-loaded target, rotating among score TIES: published
@@ -646,12 +694,49 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
         cluster_cfg.nworkers if cluster_cfg is not None
         and cluster_cfg.nworkers else 1
     )
+    # elastic sizing: the topology declares up to max_hosts ranks, only
+    # [0, min_hosts) must be live at launch — the rest are latent until
+    # they join by publishing status (a later `-procsID k` launch).
+    # Explicit peers entries ARE the topology (rank order, names and
+    # roles): max_hosts cannot invent hosts beyond them — reject the
+    # contradiction instead of silently serving a smaller fleet than
+    # the conf appears to declare
+    if fleet.peers:
+        if fleet.max_hosts and fleet.max_hosts > len(fleet.peers):
+            raise ValueError(
+                f"fleet max_hosts {fleet.max_hosts} exceeds the "
+                f"{len(fleet.peers)} declared peers entries — peers "
+                "name the whole topology, max_hosts cannot invent "
+                "hosts (netlint FLT001 flags this statically)"
+            )
+    elif fleet.max_hosts:
+        # max_hosts is a CAP, not a hint: a cluster conf declaring
+        # MORE workers than the fleet's maximum is a contradiction —
+        # silently synthesizing nworkers hosts would let latent ranks
+        # beyond the cap join and serve
+        if n_hosts > fleet.max_hosts:
+            raise ValueError(
+                f"cluster declares {n_hosts} workers but fleet "
+                f"max_hosts is {fleet.max_hosts} — the fleet cannot "
+                "exceed its declared maximum; raise max_hosts or "
+                "lower nworkers"
+            )
+        n_hosts = fleet.max_hosts
+    min_hosts = fleet.min_hosts or n_hosts
+    if not 0 < min_hosts <= n_hosts:
+        raise ValueError(
+            f"fleet min_hosts {fleet.min_hosts} / max_hosts "
+            f"{fleet.max_hosts} do not describe a fleet: need "
+            f"0 < min_hosts <= {n_hosts} (netlint FLT001 flags this "
+            "statically)"
+        )
     topo = fleet_topology(fleet, n_hosts)
     if not 0 <= procs_id < len(topo):
         raise ValueError(
             f"-procsID {procs_id} out of range for a {len(topo)}-host "
             "fleet"
         )
+    latent = {n for k, (n, _) in enumerate(topo) if k >= min_hosts}
     name, role = topo[procs_id]
     workspace = (
         cluster_cfg.workspace if cluster_cfg is not None else "."
@@ -673,6 +758,7 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
     host = FleetHost(
         name, role, engine, Mailbox(root),
         peers={n: r for n, r in topo if n != name},
+        latent=latent - {name},
         recorder=recorder, preemption=handler,
         results_to=FRONTDOOR, log=log,
     )

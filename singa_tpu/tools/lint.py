@@ -28,6 +28,7 @@ import sys
 from ..config import textproto
 from ..lint import (
     Collector,
+    elastic_rules,
     engine_rules,
     lint_cluster_text,
     lint_model_text,
@@ -75,6 +76,9 @@ def _lint_conf(
     # chunk-divisibility arm (KRN002)
     engine_rules(model_cfg, cluster_cfg, path, col)
     ring_rules(model_cfg, cluster_cfg, widths, path, col)
+    # elastic-restore admission (ELA001) needs the target mesh's axis
+    # widths, so it rides --cluster like the SHD*/KRN002 width arms
+    elastic_rules(model_cfg, widths, path, col)
     if col.count("ERROR") > errors_before:
         # the graph is already known-broken; building it would only
         # re-report the same breakage through SHP001. The config-level

@@ -28,6 +28,7 @@ synchronous ParamSync Trainer (the north-star replacement).
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 import jax
@@ -663,12 +664,27 @@ class ReplicaTrainer(Trainer):
             # writer, like the base npz path
             if jax.process_index() == 0:
                 save_checkpoint(path + ".server", step, server, snap)
+                if os.path.isdir(path):
+                    # sharded save: vouch for the sidecar we just wrote
+                    # (marker AFTER sidecar, the commit discipline) —
+                    # retention rejects the save if either tears, so a
+                    # committed shard save can never pair with a torn
+                    # protocol sidecar
+                    from ..resilience.coord import write_sidecar_commit
+
+                    write_sidecar_commit(path)
 
         return path, write_with_sidecar
 
-    def _resume(self, path: str) -> None:
-        import os
+    def _manifest_extra(self) -> dict:
+        """Promise the ``.server`` sidecar in sharded manifests: a save
+        where rank 0 died between the shard commit and the sidecar (or
+        its marker) must never validate as resumable."""
+        if self.center is None:
+            return {}
+        return {**super()._manifest_extra(), "sidecar": True}
 
+    def _resume(self, path: str) -> None:
         from .checkpoint import load_stream_positions, restore_into
         from .sharded_ckpt import is_sharded_checkpoint
 

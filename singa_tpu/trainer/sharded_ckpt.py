@@ -23,8 +23,13 @@ device->host individually (replica 0 only, so replicated arrays cost
 one copy total across the job). Restore places shards directly back
 onto their devices via jax.make_array_from_single_device_arrays when
 the target sharding matches the saved one — the array is never
-assembled on any host — and falls back to host assembly + device_put
-when the mesh or spec changed between save and restore.
+assembled on any host. When the topology changed between save and
+restore (a different process count regrouped the shard boxes, a
+different mesh re-sliced them), restore RESHARDS instead of rejecting:
+each target shard box is assembled from the intersecting saved pieces
+and placed on its own device (resilience/reshard.py — streaming
+per-target-shard, never the whole checkpoint in host memory), so a
+drained N-rank job resumes on M ranks.
 
 This is the pod-scale completion of the reference's never-used
 BlobProto/tensor_io serialization (src/proto/model.proto:342-349,
@@ -81,8 +86,15 @@ def save_sharded(
     state: dict | None = None,
     buffers: dict | None = None,
     streams: dict[str, int] | None = None,
+    manifest_extra: dict | None = None,
 ) -> str:
-    """Write this process's shards (+ manifest on process 0)."""
+    """Write this process's shards (+ manifest on process 0).
+
+    ``manifest_extra`` merges extra promises into the manifest — the
+    replica engine records ``{"sidecar": True}`` so validation can
+    demand its ``.server`` sidecar plus the sidecar commit marker
+    (a save that died between shard commit and sidecar must never
+    resume, resilience/retention.py)."""
     flat = _flatten(params, state, buffers)
     proc = jax.process_index()
     os.makedirs(path, exist_ok=True)
@@ -140,6 +152,7 @@ def save_sharded(
             "nprocs": jax.process_count(),
             "commit": COMMIT_VERSION,
             "arrays": meta,
+            **(manifest_extra or {}),
         }
         mpath = os.path.join(path, "manifest.json")
         with open(mpath + ".tmp", "w") as f:
@@ -211,6 +224,11 @@ class ShardedCheckpoint:
     def keys(self) -> list[str]:
         return sorted(self.manifest["arrays"])
 
+    def pieces(self, key: str) -> list:
+        """[(npz file, entry name, index box)] for every saved shard of
+        ``key``, across ALL proc files (the resharder's raw feed)."""
+        return self._index.get(key, [])
+
     def assemble(self, key: str) -> np.ndarray:
         """Host-assembled global array (the slow/fallback path)."""
         info = self.manifest["arrays"][key]
@@ -232,40 +250,15 @@ class ShardedCheckpoint:
 
         When the target device boxes match the saved ones exactly, each
         LOCAL shard goes straight to its device and no host ever holds
-        the global array; a box mismatch (mesh/spec changed between save
-        and restore) falls back to assemble + device_put with a warning.
-        Genuine data errors propagate — they must not be mistaken for a
-        mesh change."""
-        info = self.manifest["arrays"][key]
-        shape = tuple(info["shape"])
-        dtype = np.dtype(info["dtype"]) if dtype is None else np.dtype(dtype)
-        by_box: dict[bytes, np.ndarray] = {}
-        for z, entry, box in self._index.get(key, []):
-            by_box[_idx_key(box, len(shape))] = z[entry]
-        # only THIS process's devices: device_put to a non-addressable
-        # remote device is impossible (and unnecessary — each process
-        # restores its own shards)
-        dev_map = sharding.addressable_devices_indices_map(shape)
-        pieces = []
-        for dev, index in dev_map.items():
-            data = by_box.get(_idx_key(_idx_box(index, shape), len(shape)))
-            if data is None:
-                import warnings
+        the global array; a box mismatch (process count or mesh changed
+        between save and restore) RESHARDS — each target shard box is
+        assembled from the intersecting saved pieces and placed on its
+        own device (resilience/reshard.py). Restore-into-a-new-topology
+        is a feature, not a warning; callers wanting the per-key record
+        and the mesh admission check hold a ``Resharder`` themselves."""
+        from ..resilience.reshard import Resharder
 
-                warnings.warn(
-                    f"sharded checkpoint {self.path!r}: {key!r} saved "
-                    "with different shard boxes than the restore "
-                    "sharding (mesh changed?) — host-assembling"
-                )
-                return jax.device_put(
-                    self.assemble(key).astype(dtype, copy=False), sharding
-                )
-            pieces.append(
-                jax.device_put(data.astype(dtype, copy=False), dev)
-            )
-        return jax.make_array_from_single_device_arrays(
-            shape, sharding, pieces
-        )
+        return Resharder(self).place(key, sharding, dtype=dtype)
 
     def close(self) -> None:
         for z in self._files:
@@ -276,10 +269,6 @@ class ShardedCheckpoint:
 
     def __exit__(self, *exc):
         self.close()
-
-
-def _idx_key(box: np.ndarray, ndim: int) -> bytes:
-    return np.asarray(box[:ndim], dtype=np.int64).tobytes()
 
 
 def param_key(name: str) -> str:

@@ -489,8 +489,12 @@ class Trainer:
     def _restore_sharded(self, params, state, buffers) -> None:
         """Place a sharded checkpoint directly onto the mesh: every
         saved array goes shard-to-device (no host-global assembly when
-        the mesh matches); entries absent from the checkpoint keep
-        their fresh init."""
+        the topology matches); a checkpoint written by a DIFFERENT
+        process count or mesh reshards — each target shard assembled
+        from the intersecting saved boxes (resilience/reshard.py), so
+        a drained N-rank job resumes on M ranks. Entries absent from
+        the checkpoint keep their fresh init."""
+        from ..resilience.reshard import Resharder
         from .sharded_ckpt import (
             ShardedCheckpoint,
             buffer_key,
@@ -499,6 +503,10 @@ class Trainer:
         )
 
         with ShardedCheckpoint(self.cfg.checkpoint) as ck:
+            # mesh admission first: a target that cannot host the
+            # manifest's specs must reject loudly (ReshardError; the
+            # static mirror is netlint ELA001), never half-restore
+            resharder = Resharder(ck, dict(self.mesh.shape))
             have = set(ck.keys())
 
             def restore(key, init_val, sharding, pname=None):
@@ -540,7 +548,7 @@ class Trainer:
                 # cast to the MODEL's dtype: a checkpoint written at a
                 # different precision must not leak its dtype into the
                 # donating jitted step
-                return ck.place(key, sharding, dtype=init_val.dtype)
+                return resharder.place(key, sharding, dtype=init_val.dtype)
 
             self.params = {
                 n: restore(param_key(n), v, self.param_sh[n], pname=n)
@@ -559,8 +567,24 @@ class Trainer:
                 n: restore(buffer_key(n), v, self._buffer_sharding(n))
                 for n, v in buffers.items()
             }
+            # stream positions are CONSUMED-batch counts against the
+            # GLOBAL stream (each rank advances the same cursor; the
+            # batch shardings slice each batch, not the stream), so
+            # they are world-size-invariant: restoring them verbatim on
+            # M ranks replays and skips nothing
             self._resume_streams = dict(ck.streams)
             self.start_step = max(self.start_step, ck.step)
+            from ..resilience.coord import process_count
+
+            if resharder.saved_nprocs != process_count():
+                self.log(
+                    f"elastic restore: checkpoint written by "
+                    f"{resharder.saved_nprocs} process(es), resuming on "
+                    f"{process_count()}"
+                )
+            reshard_note = resharder.summary()
+            if reshard_note is not None:
+                self.log(f"elastic restore: {reshard_note}")
         self.log(
             f"resumed sharded from {self.cfg.checkpoint} at step "
             f"{self.start_step}"
@@ -2031,6 +2055,14 @@ class Trainer:
             rec.event("ckpt_save", step=step, path=path, mode="async")
         return path
 
+    def _manifest_extra(self) -> dict:
+        """Extra promises for a sharded save's manifest. The replica
+        engine overrides to promise its ``.server`` sidecar
+        (``{"sidecar": True}``) so retention can refuse a save whose
+        sidecar tore or never landed (resilience/coord.py sidecar
+        commit markers)."""
+        return {}
+
     def _prepare_save(self, folder: str, step: int, snapshot: bool):
         """-> (final path, zero-arg write closure) for one checkpoint.
 
@@ -2090,10 +2122,12 @@ class Trainer:
             from .sharded_ckpt import save_sharded
 
             path = os.path.join(folder, f"step_{step}.ckpt")
+            extra = self._manifest_extra()
 
             def write() -> None:
                 save_sharded(
-                    path, step, params, state, buffers, streams=streams
+                    path, step, params, state, buffers, streams=streams,
+                    manifest_extra=extra,
                 )
 
         else:

@@ -23,6 +23,14 @@ import sys
 # so pin again through jax.config (same dance as tests/conftest.py).
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("XLA_FLAGS", None)
+# the elastic-reshard drills change the PROCESS count while keeping the
+# device count (N hosts x 1 chip -> 1 host x N chips): SINGA_MP_DEVICES
+# gives this rank that many virtual CPU devices
+if os.environ.get("SINGA_MP_DEVICES"):
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        + os.environ["SINGA_MP_DEVICES"]
+    )
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 

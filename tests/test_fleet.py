@@ -473,9 +473,112 @@ class TestFleet:
             assert idle < 5, "fleet stalled after the drain"
         assert fleet_streams(hosts) == base
 
+    def test_latent_peer_gets_no_placements_until_join(self):
+        """Elastic fleet: a declared-but-unlaunched (latent) decode
+        peer must receive ZERO exports — a sequence shipped to a host
+        that may never start would be stranded. Every stream runs
+        through the live decode host."""
+        cfg = tiny_cfg()
+        params = tiny_params(cfg)
+        prompts, budgets = mixed_workload(cfg, n=4, seed=11)
+        ec = EngineConfig(slots=3, kv_block_len=8, max_prefill_chunk=4)
+        base = single_host_streams(params, cfg, ec, prompts, budgets)
+        t = LocalTransport()
+        peers_of = {
+            "p0": {"d0": "decode", "d1": "decode"},
+            "d0": {"p0": "prefill", "d1": "decode"},
+        }
+        p0 = FleetHost("p0", "prefill", Engine(params, cfg, ec), t,
+                       peers=peers_of["p0"], latent={"d1"})
+        d0 = FleetHost("d0", "decode", Engine(params, cfg, ec), t,
+                       peers=peers_of["d0"], latent={"d1"})
+        router = Router(t)
+        for i, (p, m) in enumerate(zip(prompts, budgets)):
+            router.submit(Request(rid=i, prompt=p, max_new_tokens=m))
+        run_fleet_until_done([p0, d0], len(prompts))
+        assert fleet_streams([p0, d0]) == base
+        assert d0.migrate_in == len(prompts)  # all of it landed here
+        assert p0._latent == {"d1"}  # never published, still latent
+
+    def test_fleet_join_and_leave_streams_identical(self):
+        """The elastic scale drill: a latent decode host JOINS mid-run
+        (its status publish is the announce — peers log fleet_join and
+        start placing onto it), then the ORIGINAL decode host LEAVES
+        via drain-to-peer (tombstone -> fleet_leave, its mid-stream
+        sequences migrate to the joiner) — and every token stream
+        equals the fixed-topology single-host run throughout."""
+        cfg = tiny_cfg()
+        params = tiny_params(cfg)
+        prompts, budgets = mixed_workload(cfg, n=9, seed=13)
+        ec = EngineConfig(slots=2, kv_block_len=8, max_prefill_chunk=4)
+        base = single_host_streams(params, cfg, ec, prompts, budgets)
+        t = LocalTransport()
+        topo = [("p0", "prefill"), ("d0", "decode"), ("d1", "decode")]
+
+        def mk(name, role, latent):
+            return FleetHost(
+                name, role, Engine(params, cfg, ec), t,
+                peers={m: r for m, r in topo if m != name},
+                latent=latent - {name},
+            )
+
+        p0 = mk("p0", "prefill", {"d1"})
+        d0 = mk("d0", "decode", {"d1"})
+        router = Router(t)
+        # phase 1: min_hosts fleet serves the first third
+        for i in range(3):
+            router.submit(Request(
+                rid=i, prompt=prompts[i], max_new_tokens=budgets[i],
+            ))
+        run_fleet_until_done([p0, d0], 3)
+        assert d0.migrate_in == 3 and p0._latent == {"d1"}
+        # phase 2: d1 JOINS (construction registers + publishes its
+        # serving status — the announce) and starts taking placements
+        d1 = mk("d1", "decode", set())
+        for i in range(3, 6):
+            router.submit(Request(
+                rid=i, prompt=prompts[i], max_new_tokens=budgets[i],
+            ))
+        run_fleet_until_done([p0, d0, d1], 6)
+        assert p0._latent == set(), "join not observed by the prefill host"
+        assert d1.migrate_in >= 1, (
+            "the joined decode host took no placements"
+        )
+        # phase 3: scale DOWN — d0 drains mid-stream; its decoding
+        # sequences migrate to the joiner, and peers re-latent it
+        for i in range(6, 9):
+            router.submit(Request(
+                rid=i, prompt=prompts[i], max_new_tokens=budgets[i],
+            ))
+        for _ in range(4):
+            for h in (p0, d0, d1):
+                h.tick()
+        acct = d0.drain("scale-down")
+        assert all(m["dst"] == "d1" for m in acct["migrated"]), acct
+        alive = [p0, d1]
+        idle = 0
+        for _ in range(2000):
+            for h in alive:
+                h.tick()
+            if len(fleet_streams([p0, d0, d1])) >= len(prompts):
+                break
+            idle = idle + 1 if not any(h.busy for h in alive) else 0
+            assert idle < 5, "fleet stalled after the scale-down"
+        assert fleet_streams([p0, d0, d1]) == base
+        # the next placement decision observes the tombstone: d0 is
+        # latent again (a future status publish is a fresh join) and
+        # never a candidate
+        assert p0._pick_peer(("decode", "unified")) == "d1"
+        assert "d0" in p0._latent, (
+            "the drained host must be latent again (a future status "
+            "publish is a fresh join)"
+        )
+
     def test_decode_only_fleet_rejected(self):
         """The runtime arm netlint FLT001 mirrors: a split-role host
-        with no peer for the other half refuses to construct."""
+        with no peer for the other half refuses to construct — and a
+        peer that is merely DECLARED (latent, may never launch) does
+        not count as the other half."""
         cfg = tiny_cfg()
         params = tiny_params(cfg)
         ec = EngineConfig(slots=2, kv_block_len=8)
@@ -483,6 +586,9 @@ class TestFleet:
         with pytest.raises(ValueError, match="no prefill-capable peer"):
             FleetHost("d0", "decode", Engine(params, cfg, ec), t,
                       peers={"d1": "decode"})
+        with pytest.raises(ValueError, match="no decode-capable peer"):
+            FleetHost("p0", "prefill", Engine(params, cfg, ec), t,
+                      peers={"d0": "decode"}, latent={"d0"})
         with pytest.raises(ValueError, match="no decode-capable peer"):
             FleetHost("p0", "prefill", Engine(params, cfg, ec), t,
                       peers={})
@@ -811,6 +917,102 @@ class TestFleetConf:
             d.code == "CFG002" and "decode" in (d.fix_hint or "")
             for d in col.sorted()
         ), [str(d) for d in col.sorted()]
+        # the elastic sizing knobs are schema-covered too
+        for typo, want in (
+            ("min_host: 1", "min_hosts"),
+            ("max_hots: 3", "max_hosts"),
+        ):
+            col = Collector()
+            lint_model_text(
+                FLEET_CONF.replace(
+                    'fleet { role: "auto"',
+                    'fleet { ' + typo + ' role: "auto"',
+                ),
+                "job.conf", col,
+            )
+            assert any(
+                d.code == "CFG001" and want in (d.fix_hint or "")
+                for d in col.sorted()
+            ), (typo, [str(d) for d in col.sorted()])
+
+    def test_flt001_elastic_sizing(self):
+        """FLT001's sizing arm: min_hosts above the declared topology
+        (peers/max_hosts) can never launch; consistent sizing stays
+        silent."""
+        from singa_tpu.lint import Collector, lint_model_text
+
+        def flt(block):
+            col = Collector()
+            lint_model_text(
+                FLEET_CONF.replace(
+                    'fleet { role: "auto" prefill_hosts: 1 }', block,
+                ),
+                "job.conf", col,
+            )
+            return [d for d in col.sorted() if d.code == "FLT001"]
+
+        got = flt(
+            'fleet { role: "auto" min_hosts: 5 max_hosts: 3 }'
+        )
+        assert len(got) == 1 and "min_hosts 5" in got[0].msg, got
+        assert not flt(
+            'fleet { role: "auto" min_hosts: 2 max_hosts: 3 }'
+        )
+        # without a declared bound the host count is a runtime fact
+        assert not flt('fleet { role: "auto" min_hosts: 2 }')
+        # explicit peers ARE the topology: max_hosts cannot invent
+        # hosts beyond them, and min_hosts is measured against the
+        # peers count (NOT a phantom max_hosts)
+        peers2 = (
+            'peers { name: "p" role: "prefill" }\n'
+            'peers { name: "d" role: "decode" }'
+        )
+        got = flt(f'fleet {{ {peers2} max_hosts: 4 min_hosts: 3 }}')
+        msgs = " | ".join(d.msg for d in got)
+        assert "max_hosts 4 exceeds" in msgs, got
+        assert "min_hosts 3 exceeds" in msgs, got
+        # (d) a live prefix covering only one half: the decode half is
+        # entirely latent, so the fleet would launch but never stream
+        got = flt(f'fleet {{ {peers2} min_hosts: 1 }}')
+        assert len(got) == 1 and "live prefix" in got[0].msg, got
+        assert not flt(f'fleet {{ {peers2} min_hosts: 2 }}')
+        # a unified live prefix is self-sufficient at any min_hosts
+        assert not flt(
+            'fleet { peers { name: "u" role: "unified" }\n'
+            '        peers { name: "d" role: "decode" } min_hosts: 1 }'
+        )
+        # the auto rank-split live prefix is statically decidable too
+        got = flt(
+            'fleet { role: "auto" prefill_hosts: 1 min_hosts: 1 '
+            'max_hosts: 3 }'
+        )
+        assert len(got) == 1 and "prefill-only" in got[0].msg, got
+        assert not flt(
+            'fleet { role: "auto" prefill_hosts: 1 min_hosts: 2 '
+            'max_hosts: 3 }'
+        )
+        # the runtime mirror: run_from_conf rejects the same conf
+        from singa_tpu.config import parse_model_config
+        from singa_tpu.serve.fleet.host import run_from_conf
+
+        bad = parse_model_config(FLEET_CONF.replace(
+            'fleet { role: "auto" prefill_hosts: 1 }',
+            f'fleet {{ {peers2} max_hosts: 4 }}',
+        ))
+        with pytest.raises(ValueError, match="cannot invent hosts"):
+            run_from_conf(bad, None, procs_id=0)
+        # and in the auto form, max_hosts is a CAP: a cluster conf
+        # declaring more workers than it rejects instead of silently
+        # synthesizing joinable hosts beyond the declared maximum
+        from singa_tpu.config.schema import ClusterConfig
+
+        capped = parse_model_config(FLEET_CONF.replace(
+            'fleet { role: "auto" prefill_hosts: 1 }',
+            'fleet { role: "auto" prefill_hosts: 1 max_hosts: 2 }',
+        ))
+        cl = ClusterConfig(nworkers=4, workspace="ws")
+        with pytest.raises(ValueError, match="cannot exceed"):
+            run_from_conf(capped, cl, procs_id=0)
 
     def test_flt001_prefill_pool_too_small(self):
         from singa_tpu.lint import Collector, lint_model_text

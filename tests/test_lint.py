@@ -873,3 +873,121 @@ def test_cli_ngroups_only_error_is_clu002_once(capsys, tmp_path):
     rc, codes, doc = run_cli(capsys, str(p))
     assert rc == 1 and codes == {"CLU002"}
     assert doc["counts"]["ERROR"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ELA001: elastic-restore mesh admission (the reshard.py static mirror)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_job(tmp_path, spec, shape=(8, 8)):
+    """A model conf whose `checkpoint` names a forged sharded dir with
+    one manifest entry of the given saved spec, plus a 2-worker
+    cluster conf (data axis width 2)."""
+    ck = tmp_path / "step_8.ckpt"
+    ck.mkdir(exist_ok=True)
+    (ck / "manifest.json").write_text(json.dumps({
+        "format": "singa-tpu-sharded-v1",
+        "step": 8,
+        "nprocs": 4,
+        "arrays": {
+            "p|w": {
+                "shape": list(shape), "dtype": "float32", "spec": spec,
+            }
+        },
+    }))
+    job = tmp_path / "job.conf"
+    job.write_text(
+        f"""
+        name: "elastic"
+        train_steps: 2
+        checkpoint: "{ck}"
+        neuralnet {{
+          layer {{ name: "data" type: "kShardData"
+                  data_param {{ path: "nope" batchsize: 8 }} }}
+        }}
+        """
+    )
+    cluster = tmp_path / "cluster.conf"
+    cluster.write_text(
+        'nworkers: 2\nnprocs_per_group: 1\nworkspace: "ws"\n'
+    )
+    return str(job), str(cluster)
+
+
+def test_ela001_foreign_axis_fires_with_cluster(capsys, tmp_path):
+    job, cluster = _elastic_job(tmp_path, ["rows", None])
+    rc, codes, doc = run_cli(capsys, job, "--cluster", cluster)
+    assert rc == 1 and "ELA001" in codes
+    ela = [d for d in doc["diagnostics"] if d["code"] == "ELA001"]
+    assert "'rows'" in ela[0]["msg"] and "p|w" in ela[0]["msg"]
+    # without --cluster there is no target mesh: not statically
+    # decidable, silent (SRV001's window discipline)
+    rc, codes, _ = run_cli(capsys, job)
+    assert "ELA001" not in codes
+
+
+def test_ela001_more_shards_than_elements(capsys, tmp_path):
+    # dim 1 sharded over the 2-wide data axis: beyond even the
+    # pad/replicate fallback
+    job, cluster = _elastic_job(tmp_path, ["data", None], shape=(1, 8))
+    rc, codes, doc = run_cli(capsys, job, "--cluster", cluster)
+    assert rc == 1 and "ELA001" in codes
+    assert "more shards than elements" in [
+        d for d in doc["diagnostics"] if d["code"] == "ELA001"
+    ][0]["msg"]
+
+
+def test_ela001_hostable_checkpoint_is_silent(capsys, tmp_path):
+    # a perfectly reshardable manifest (data-axis spec, divisible dim):
+    # the 4-proc save restoring onto the 2-worker cluster is exactly
+    # the elastic path working as intended
+    job, cluster = _elastic_job(tmp_path, ["data", None])
+    rc, codes, _ = run_cli(capsys, job, "--cluster", cluster)
+    assert "ELA001" not in codes
+    # absent checkpoint path: nothing statically decidable
+    job2, cluster2 = _elastic_job(tmp_path, ["data", None])
+    conf = pathlib.Path(job2).read_text().replace(
+        str(tmp_path / "step_8.ckpt"), str(tmp_path / "not_there.ckpt")
+    )
+    pathlib.Path(job2).write_text(conf)
+    rc, codes, _ = run_cli(capsys, job2, "--cluster", cluster2)
+    assert "ELA001" not in codes
+
+
+def test_ela001_foreign_format_manifest_is_silent(capsys, tmp_path):
+    """A manifest the runtime resharder would never load (wrong format
+    tag — ShardedCheckpoint rejects it before any reshard verdict)
+    must not get a lint verdict either: lint and runtime agree."""
+    job, cluster = _elastic_job(tmp_path, ["rows", None])
+    manifest = tmp_path / "step_8.ckpt" / "manifest.json"
+    doc = json.loads(manifest.read_text())
+    doc["format"] = "someone-elses-checkpoint-v9"
+    manifest.write_text(json.dumps(doc))
+    rc, codes, _ = run_cli(capsys, job, "--cluster", cluster)
+    assert "ELA001" not in codes
+
+
+def test_ela001_dedupes_by_reason(tmp_path):
+    """200 params sharing one bad axis are ONE diagnostic (naming an
+    exemplar + a count), not 200."""
+    from singa_tpu.lint import Collector, elastic_rules
+
+    ck = tmp_path / "step_2.ckpt"
+    ck.mkdir()
+    (ck / "manifest.json").write_text(json.dumps({
+        "format": "singa-tpu-sharded-v1",
+        "nprocs": 2,
+        "arrays": {
+            f"p|w{i}": {
+                "shape": [8], "dtype": "float32", "spec": ["rows"],
+            }
+            for i in range(5)
+        },
+    }))
+    cfg = ModelConfig()
+    cfg.checkpoint = str(ck)
+    col = Collector()
+    elastic_rules(cfg, {"data": 2, "model": 1}, "job.conf", col)
+    ela = [d for d in col.sorted() if d.code == "ELA001"]
+    assert len(ela) == 1 and "+4 more entries" in ela[0].msg

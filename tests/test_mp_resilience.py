@@ -102,9 +102,13 @@ def _write_job(tmp_path, tag: str, steps: int, heartbeat_s: float,
     return model_conf, cluster_conf, os.path.join(ws, "checkpoints")
 
 
-def _launch(tmp_path, tag, model_conf, cluster_conf, nprocs=2, faults=None):
+def _launch(tmp_path, tag, model_conf, cluster_conf, nprocs=2, faults=None,
+            devices_per_proc=1):
     """Launch nprocs ranks through the real CLI; return
-    rank -> (returncode, log text, params-or-None)."""
+    rank -> (returncode, log text, params-or-None).
+    ``devices_per_proc`` gives each rank that many virtual CPU devices
+    (the elastic drills consolidate N hosts' chips onto fewer hosts —
+    the mesh keeps its width, the process count changes)."""
     port = _free_port()
     hostfile = tmp_path / f"hostfile_{tag}"
     hostfile.write_text(
@@ -113,8 +117,10 @@ def _launch(tmp_path, tag, model_conf, cluster_conf, nprocs=2, faults=None):
     )
     env = {
         k: v for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "SINGA_MP_DEVICES")
     }
+    if devices_per_proc > 1:
+        env["SINGA_MP_DEVICES"] = str(devices_per_proc)
     procs = []
     results = {}
     try:
@@ -361,4 +367,85 @@ def test_crash_on_one_rank_resumes_bitwise_identically(tmp_path):
         np.testing.assert_array_equal(
             dumps[0][name], oracle[name],
             err_msg=f"resumed run diverged from uninterrupted: {name}",
+        )
+
+
+@pytest.mark.slow
+def test_elastic_reshard_2_to_1_to_2_loss_identical(tmp_path):
+    """The elastic-restore drill (ISSUE 15 acceptance): a 2-rank job is
+    drained at step 8; the SAME job resumes on ONE rank (hosting both
+    chips — the elastic TPU shape: N hosts x 1 chip -> 1 host x 2
+    chips, mesh width preserved) via reshard-on-load, drains again at
+    step 14; and a 2-rank relaunch resumes the 1-rank save (the other
+    direction) to completion. Final params are BITWISE an uninterrupted
+    2-rank run's — which subsumes loss-identity (tol 0) of the 1-rank
+    leg. The config composes everything the resharder must carry:
+    ZeRO update-layout opt-state shards, quantized-grad error-feedback
+    residuals, and consumed stream positions (no batch replayed or
+    skipped across either world-size change)."""
+    # uninterrupted 2-rank oracle, separate workspace
+    clean_model, clean_cluster, _ = _write_job(
+        tmp_path, "eclean", steps=20, heartbeat_s=30.0, zero=True,
+        grad_comm=True,
+    )
+    clean = _launch(tmp_path, "eclean", clean_model, clean_cluster)
+    for rank, (rc, log_text, _) in clean.items():
+        assert rc == 0, f"clean rank {rank} rc={rc}\nlog:\n{log_text}"
+
+    model_conf, cluster_conf, ck_dir = _write_job(
+        tmp_path, "elastic", steps=20, heartbeat_s=30.0, zero=True,
+        grad_comm=True,
+    )
+    # leg 1: 2 ranks x 1 device, drained at step 8
+    leg1 = _launch(
+        tmp_path, "eleg1", model_conf, cluster_conf,
+        faults="sigterm@8:rank=0",
+    )
+    for rank, (rc, log_text, _) in leg1.items():
+        assert rc == EXIT_RESUMABLE, f"rank {rank} rc={rc}\n{log_text}"
+    step8 = retention.resolve_latest(ck_dir)
+    assert step8 is not None and step8.endswith("step_8.ckpt"), step8
+    with open(os.path.join(step8, "manifest.json")) as f:
+        assert json.load(f)["nprocs"] == 2
+
+    # leg 2: ONE rank hosting the width-2 mesh (2 virtual devices)
+    # resumes the 2-proc save — the supervisor announces the elastic
+    # restore and the trainer reshards on load — then drains at 14
+    leg2 = _launch(
+        tmp_path, "eleg2", model_conf, cluster_conf, nprocs=1,
+        devices_per_proc=2, faults="sigterm@14",
+    )
+    rc2, log2, _ = leg2[0]
+    assert rc2 == EXIT_RESUMABLE, f"leg2 rc={rc2}\n{log2}"
+    assert "elastic restore" in log2 and "written by 2 process(es)" in log2
+    assert "resumed sharded from" in log2 and "step_8" in log2
+    step14 = retention.resolve_latest(ck_dir)
+    assert step14 is not None and step14.endswith("step_14.ckpt"), step14
+    with open(os.path.join(step14, "manifest.json")) as f:
+        assert json.load(f)["nprocs"] == 1
+    # the 1-rank re-save carries no stale 2-rank shard files
+    assert not os.path.exists(os.path.join(step14, "proc_1.npz"))
+    assert not os.path.exists(os.path.join(step14, "commit_1.json"))
+
+    # leg 3: 2 ranks resume the 1-proc save (the other direction) and
+    # finish; params must be BITWISE the uninterrupted oracle's
+    leg3 = _launch(tmp_path, "eleg3", model_conf, cluster_conf)
+    dumps = []
+    for rank, (rc, log_text, params) in leg3.items():
+        assert rc == 0, f"leg3 rank {rank} rc={rc}\nlog:\n{log_text}"
+        assert "elastic restore" in log_text
+        assert "resumed sharded from" in log_text and "step_14" in log_text
+        dumps.append(params)
+    oracle = clean[0][2]
+    assert set(dumps[0]) == set(oracle)
+    for name in dumps[0]:
+        np.testing.assert_array_equal(
+            dumps[0][name], dumps[1][name], err_msg=name
+        )
+        np.testing.assert_array_equal(
+            dumps[0][name], oracle[name],
+            err_msg=(
+                f"2->1->2 elastic resume diverged from the "
+                f"uninterrupted 2-rank run: {name}"
+            ),
         )
