@@ -789,6 +789,30 @@ FLEET_ROLES = ("unified", "prefill", "decode", "auto")
 FLEET_PEER_ROLES = ("unified", "prefill", "decode")
 
 
+class FleetLoadConfig(Message):
+    """singa-tpu extension: the offered-load model for the cost-aware
+    shardlint's fleet sizing rule (lint/cost_model.py FLT002). Declares
+    the traffic the fleet is sized for; netlint checks each role's
+    aggregate capacity against it — decode capacity is
+    ``decode_hosts * serving.slots * ticks_per_s`` tokens/s (every live
+    slot emits one token per tick), prefill capacity is
+    ``prefill_hosts * serving.max_prefill_chunk * ticks_per_s``
+    prompt tokens/s (one chunk per host per tick). The rule only runs
+    when ``requests_per_s`` and ``ticks_per_s`` are both positive —
+    an absent or zeroed block declares no load model and is skipped."""
+
+    FIELDS = {
+        # steady-state request arrival rate the fleet must absorb
+        "requests_per_s": Field("float", 0.0),
+        # mean prompt length per request (prefill token demand)
+        "prompt_tokens": Field("int", 0),
+        # mean generated tokens per request (decode token demand)
+        "decode_tokens": Field("int", 0),
+        # engine step rate per host (decode ticks == prefill ticks)
+        "ticks_per_s": Field("float", 0.0),
+    }
+
+
 class FleetPeerConfig(Message):
     """One host of a disaggregated serving fleet (serve/fleet/): its
     mailbox name and concrete role. Listed in RANK ORDER — entry k is
@@ -840,6 +864,9 @@ class FleetConfig(Message):
         # live at launch (the fixed fleet; today's behavior). ---
         "min_hosts": Field("int", 0),
         "max_hosts": Field("int", 0),
+        # offered-load model for the cost-aware shardlint's per-role
+        # fleet sizing (FLT002); absent = no declared load, rule skipped
+        "load": Field("message", message=FleetLoadConfig),
     }
 
 
@@ -1031,6 +1058,14 @@ class ClusterConfig(Message):
         # <workspace>/compile_cache; "off" disables; the
         # SINGA_TPU_COMPILE_CACHE env var overrides either.
         "compile_cache_dir": Field("string", ""),
+        # ---- singa-tpu extension: per-device HBM budget in bytes for
+        # the cost-aware shardlint (lint/cost_model.py). When > 0,
+        # netlint's MEM001 errors on any model conf whose predicted
+        # per-device footprint (params + optimizer slots + residuals +
+        # activation working set + serving KV pool) exceeds it — the
+        # static mirror of the OOM the pod would hit. 0 (default) =
+        # no declared budget, MEM001 stays silent.
+        "device_hbm_bytes": Field("int", 0),
     }
 
     @property

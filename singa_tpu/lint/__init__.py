@@ -28,6 +28,12 @@ from .core import (  # noqa: F401
     render_rule_table,
     render_text,
 )
+from .cost_model import (  # noqa: F401
+    CostReport,
+    build_cost_model,
+    cost_rules,
+    render_cost_report,
+)
 from .net_rules import (  # noqa: F401
     elastic_rules,
     engine_rules,

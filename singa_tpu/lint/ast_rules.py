@@ -133,6 +133,69 @@ def _contains_jnp(node: ast.AST) -> bool:
     return False
 
 
+def _tracer_names(fn: ast.AST) -> set[str]:
+    """Names that hold tracer values inside ``fn``'s body: assigned from
+    an expression that mentions ``jnp`` — or one that reads an
+    already-tracked name, so aliases and simple derivations
+    (``y = x * 2``) stay tracked through assignment chains. A later
+    rebind to a plain literal un-tracks the name (the value is a static
+    Python scalar again), keeping the false-positive bar: only names the
+    pass can PROVE tracer-valued at some point are tracked. Statements
+    are visited in source order, so tracking follows dataflow order."""
+    tracked: set[str] = set()
+
+    def is_tracer(value: ast.AST) -> bool:
+        if _contains_jnp(value):
+            return True
+        return any(
+            isinstance(s, ast.Name)
+            and isinstance(s.ctx, ast.Load)
+            and s.id in tracked
+            for s in ast.walk(value)
+        )
+
+    def bind(target: ast.AST, tracer: bool, literal: bool) -> None:
+        for t in ast.walk(target):
+            if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+                if tracer:
+                    tracked.add(t.id)
+                elif literal:
+                    tracked.discard(t.id)
+
+    def visit(parent: ast.AST) -> None:
+        # iter_child_nodes (not ast.walk, which is breadth-first) keeps
+        # statements in SOURCE order, so tracking follows dataflow order
+        for node in ast.iter_child_nodes(parent):
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                value, targets = node.value, [node.target]
+            if value is not None:
+                tracer = is_tracer(value)
+                if isinstance(node, ast.AugAssign):
+                    # x += <expr>: x keeps its prior trackedness unless
+                    # the rhs makes it a tracer — never un-track on
+                    # augmented literals
+                    bind(node.target, tracer, False)
+                else:
+                    for tgt in targets:
+                        bind(tgt, tracer, _is_literal(value))
+            visit(node)
+
+    visit(fn)
+    return tracked
+
+
+def _mentions_tracked(node: ast.AST, tracked: set[str]) -> bool:
+    return any(
+        isinstance(s, ast.Name)
+        and isinstance(s.ctx, ast.Load)
+        and s.id in tracked
+        for s in ast.walk(node)
+    )
+
+
 def _is_literal(node: ast.AST) -> bool:
     if isinstance(node, ast.Constant):
         return True
@@ -246,9 +309,13 @@ class _FileLinter:
     # ---------------- rules ----------------
 
     def check_jitted_body(self, fn: ast.AST) -> None:
+        # dataflow widening: names assigned from jnp expressions (or
+        # from other tracked names) count as tracer-valued, so aliased
+        # escapes like ``y = x * 2; return float(y)`` are caught too
+        tracked = _tracer_names(fn)
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
-                self._host_sync_rules(node)
+                self._host_sync_rules(node, tracked)
             elif isinstance(node, (ast.If, ast.While)):
                 if _contains_jnp(node.test):
                     kind = "if" if isinstance(node, ast.If) else "while"
@@ -264,7 +331,9 @@ class _FileLinter:
                         or node.lineno,
                     )
 
-    def _host_sync_rules(self, node: ast.Call) -> None:
+    def _host_sync_rules(
+        self, node: ast.Call, tracked: set[str] = frozenset()
+    ) -> None:
         func = node.func
         # x.item() — device sync + concretization error under trace
         if isinstance(func, ast.Attribute) and func.attr == "item":
@@ -275,12 +344,16 @@ class _FileLinter:
                 fix_hint="return the array and read it outside the jit",
             )
             return
-        # float(<jnp expr>) / int(<jnp expr>)
+        # float(<jnp expr>) / int(<jnp expr>) — or the same on a name
+        # the dataflow pass tracked back to a jnp assignment
         if (
             isinstance(func, ast.Name)
             and func.id in ("float", "int", "bool")
             and node.args
-            and _contains_jnp(node.args[0])
+            and (
+                _contains_jnp(node.args[0])
+                or _mentions_tracked(node.args[0], tracked)
+            )
         ):
             self.emit(
                 JAX001,

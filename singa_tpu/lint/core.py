@@ -34,6 +34,21 @@ _SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
 
 
 @dataclasses.dataclass(frozen=True)
+class Fix:
+    """A machine-applicable single-token rewrite: replace ``old`` at
+    (1-based) ``line``/``col`` of ``path`` with ``new``. Only attached
+    when the fix is unambiguous (exactly one did-you-mean candidate)
+    and the token's exact span is known — ``tools/lint.py --fix``
+    re-verifies the text at the span before touching the file."""
+
+    path: str
+    line: int
+    col: int
+    old: str
+    new: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Diagnostic:
     """One finding: machine code + severity + location + message."""
 
@@ -42,6 +57,8 @@ class Diagnostic:
     loc: str  # "path", "path:layer=name", or "path:LINE:COL"
     msg: str
     fix_hint: str = ""
+    #: optional machine-applicable rewrite (--fix); None = advisory only
+    fix: Fix | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -92,11 +109,14 @@ class Collector:
         *,
         fix_hint: str = "",
         severity: str | None = None,
+        fix: Fix | None = None,
     ) -> None:
         if r.code in self.ignore:
             return
         self.diagnostics.append(
-            Diagnostic(r.code, severity or r.severity, loc, msg, fix_hint)
+            Diagnostic(
+                r.code, severity or r.severity, loc, msg, fix_hint, fix
+            )
         )
 
     # ---------------- summary ----------------

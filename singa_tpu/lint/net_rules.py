@@ -28,7 +28,7 @@ from ..config.schema import (
 )
 from ..graph.builder import active_phases
 from ..graph.kahn import kahn_order
-from .core import Collector, ERROR, INFO, WARNING, rule
+from .core import Collector, ERROR, Fix, INFO, WARNING, rule
 
 # ---------------------------------------------------------------------------
 # rules
@@ -112,10 +112,13 @@ _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
 
 
 def _line_of(text: str | None, needle: str) -> str:
-    """Best-effort line locator (the textproto parser keeps no positions):
-    first line containing ``needle`` as a whole token — a bare substring
+    """Fallback line locator for callers without a parse span: first
+    line containing ``needle`` as a whole token — a bare substring
     scan would attribute 'kGaussain' to a line holding
-    'kGaussainSqrtFanIn'. Falls back to substring if no token match."""
+    'kGaussainSqrtFanIn'. Falls back to substring if no token match.
+    The parse tree's own spans (textproto.parse_with_locs) are the
+    primary source; this text search only covers needles that never
+    were tokens (e.g. messages quoting a converted value)."""
     if not text:
         return ""
     token = re.compile(
@@ -130,9 +133,21 @@ def _line_of(text: str | None, needle: str) -> str:
     return fallback
 
 
-def _loc(path: str, text: str | None, needle: str, ctx: str) -> str:
-    line = _line_of(text, needle)
-    base = f"{path}:{line}" if line else path
+def _loc(
+    path: str,
+    text: str | None,
+    needle: str,
+    ctx: str,
+    span: tuple[int, int] | None = None,
+) -> str:
+    """Diagnostic location: ``path:LINE:COL`` from an exact parse span
+    when the caller has one (a lookup, not a search), else the
+    best-effort ``path:LINE`` text scan."""
+    if span is not None:
+        base = f"{path}:{span[0]}:{span[1]}"
+    else:
+        line = _line_of(text, needle)
+        base = f"{path}:{line}" if line else path
     return f"{base} ({ctx})" if ctx else base
 
 
@@ -144,45 +159,81 @@ def walk_raw_config(
     *,
     text: str | None = None,
     ctx: str = "",
+    locs: dict[str, list[textproto.FieldLoc]] | None = None,
     _seen_typos: set[tuple[str, str]] | None = None,
 ) -> None:
     """Check a textproto parse tree against ``cls``'s field schema,
     emitting CFG001/CFG002/CFG003 for everything wrong (the strict
     ``Message.from_fields`` stops at the first error; lint wants all).
     CFG003 is advisory, so it fires once per (field, spelling) per file
-    rather than once per occurrence."""
+    rather than once per occurrence. ``locs`` is the parallel span tree
+    from ``textproto.parse_with_locs`` — when present, diagnostics carry
+    exact ``path:LINE:COL`` locations and unambiguous did-you-mean
+    suggestions carry a machine-applicable Fix (``--fix``)."""
     if _seen_typos is None:
         _seen_typos = set()
     for fname, occurrences in raw.items():
+        flocs = (locs or {}).get(fname, [])
+
+        def span_of(i: int, *, value: bool = False):
+            if i < len(flocs):
+                fl = flocs[i]
+                return fl.value if value else fl.key
+            return None
+
         spec = cls.FIELDS.get(fname)
         if spec is None:
-            close = difflib.get_close_matches(fname, cls.FIELDS, n=1)
+            close = difflib.get_close_matches(fname, cls.FIELDS, n=2)
             hint = f"did you mean {close[0]!r}?" if close else ""
+            span = span_of(0)
+            fix = None
+            if len(close) == 1 and span is not None:
+                fix = Fix(path, span[0], span[1], fname, close[0])
             col.emit(
                 CFG001,
-                _loc(path, text, fname, ctx),
+                _loc(path, text, fname, ctx, span),
                 f"unknown field {fname!r} in {cls.__name__}",
                 fix_hint=hint,
+                fix=fix,
             )
             continue
         if spec.kind == "message":
-            dicts = [occ for occ in occurrences if isinstance(occ, dict)]
+            pairs = [
+                (occ, span_of(i))
+                for i, occ in enumerate(occurrences)
+            ]
+            dicts = [(o, s) for o, s in pairs if isinstance(o, dict)]
+            sublocs = [
+                flocs[i].sub if i < len(flocs) else None
+                for i, occ in enumerate(occurrences)
+                if isinstance(occ, dict)
+            ]
             if len(dicts) < len(occurrences):
+                bad = next(s for o, s in pairs if not isinstance(o, dict))
                 col.emit(
                     CFG000,
-                    _loc(path, text, fname, ctx),
+                    _loc(path, text, fname, ctx, bad),
                     f"field {fname!r} expects a message block",
                 )
             if not spec.repeated and len(dicts) > 1:
                 # protobuf text-format merge (schema.from_fields): walk
                 # the merged tree once, so a required subfield present in
-                # any occurrence is not misreported as missing
+                # any occurrence is not misreported as missing — the loc
+                # trees merge the same way, keeping spans aligned
                 merged: dict[str, list[Any]] = {}
-                for occ in dicts:
+                merged_locs: dict[str, list] = {}
+                for (occ, _), sl in zip(dicts, sublocs):
                     for sub, subvals in occ.items():
                         merged.setdefault(sub, []).extend(subvals)
-                dicts = [merged]
-            for occ in dicts:
+                        merged_locs.setdefault(sub, []).extend(
+                            (sl or {}).get(
+                                sub,
+                                [textproto.FieldLoc(None)] * len(subvals),
+                            )
+                        )
+                dicts = [(merged, None)]
+                sublocs = [merged_locs]
+            for (occ, _), sl in zip(dicts, sublocs):
                 sub_ctx = fname
                 names = occ.get("name")
                 if names and isinstance(names[-1], str):
@@ -196,14 +247,16 @@ def walk_raw_config(
                     col,
                     text=text,
                     ctx=sub_ctx,
+                    locs=sl,
                     _seen_typos=_seen_typos,
                 )
         elif spec.kind == "enum":
-            for occ in occurrences:
+            for i, occ in enumerate(occurrences):
                 if not isinstance(occ, str):
                     continue
                 if occ in spec.enum and occ not in _TYPO_NOTES:
                     continue  # exact member, nothing to say
+                vspan = span_of(i, value=True)
                 if occ in _TYPO_NOTES and occ in spec.enum:
                     # a [sic] token used where it is actually valid: note
                     # the corrected spelling. Used in the WRONG field it
@@ -212,7 +265,7 @@ def walk_raw_config(
                         _seen_typos.add((fname, occ))
                         col.emit(
                             CFG003,
-                            _loc(path, text, occ, ""),
+                            _loc(path, text, occ, "", vspan),
                             f"{fname}: {occ!r} is the reference's [sic] "
                             f"spelling; the corrected {_TYPO_NOTES[occ]!r} "
                             "is accepted as an alias",
@@ -225,23 +278,34 @@ def walk_raw_config(
                         for a, t in schema.ENUM_ALIASES.items()
                         if t in spec.enum
                     ]
-                    close = difflib.get_close_matches(occ, vocab, n=1)
+                    close = difflib.get_close_matches(occ, vocab, n=2)
                     hint = f"did you mean {close[0]!r}?" if close else ""
+                    fix = None
+                    if len(close) == 1 and vspan is not None:
+                        fix = Fix(path, vspan[0], vspan[1], occ, close[0])
                     col.emit(
                         CFG002,
-                        _loc(path, text, occ, ctx),
+                        _loc(path, text, occ, ctx, vspan),
                         f"{fname}: {occ!r} not in {spec.enum}",
                         fix_hint=hint,
+                        fix=fix,
                     )
         else:
             # scalar kinds: report every coercion failure with the exact
             # text the strict parse would use (it stops at the first; the
             # caller dedups by message)
-            for occ in occurrences:
+            for i, occ in enumerate(occurrences):
                 try:
                     spec.convert(occ, fname)
                 except ConfigError as e:
-                    col.emit(CFG000, _loc(path, text, str(occ), ctx), str(e))
+                    col.emit(
+                        CFG000,
+                        _loc(
+                            path, text, str(occ), ctx,
+                            span_of(i, value=True),
+                        ),
+                        str(e),
+                    )
     for fname, spec in cls.FIELDS.items():
         if (
             spec.required
@@ -953,6 +1017,20 @@ def sharding_rules_static(
                 )
 
 
+def _locs_of(
+    text: str | None,
+) -> dict[str, list[textproto.FieldLoc]] | None:
+    """The span tree for ``text``, or None when it cannot be lexed (the
+    caller already reported the parse failure — spans are best-effort)."""
+    if not text:
+        return None
+    try:
+        _, locs = textproto.parse_with_locs(text)
+    except textproto.TextProtoError:
+        return None
+    return locs
+
+
 _UNKNOWN_FIELD = re.compile(r"unknown field '([^']+)'")
 _BAD_ENUM = re.compile(r"field '[^']+': ('[^']+') not in enum")
 
@@ -1000,7 +1078,9 @@ def lint_model_text(
             col.emit(CFG000, path, str(e))
             return None
     before = len(col.diagnostics)
-    walk_raw_config(raw, ModelConfig, path, col, text=text)
+    walk_raw_config(
+        raw, ModelConfig, path, col, text=text, locs=_locs_of(text)
+    )
     try:
         model_cfg = ModelConfig.from_fields(raw)
     except ConfigError as e:
@@ -1031,7 +1111,9 @@ def lint_cluster_text(
             col.emit(CFG000, path, str(e))
             return None, None
     before = len(col.diagnostics)
-    walk_raw_config(raw, ClusterConfig, path, col, text=text)
+    walk_raw_config(
+        raw, ClusterConfig, path, col, text=text, locs=_locs_of(text)
+    )
     try:
         cluster_cfg = ClusterConfig.from_fields(raw)
     except ConfigError as e:

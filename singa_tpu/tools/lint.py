@@ -6,6 +6,11 @@ Usage:
   python -m singa_tpu.tools.lint --self                    # AST pass over
                                                            # singa_tpu/
   python -m singa_tpu.tools.lint --list-rules              # rule catalogue
+  python -m singa_tpu.tools.lint job.conf --cluster c.conf --explain-cost
+                                                           # cost report
+  python -m singa_tpu.tools.lint job.conf --fix [--dry-run]
+                                                           # did-you-mean
+                                                           # rewrites
 
 Paths may be .conf files, .py files, or directories (recursively linting
 both kinds). Model vs cluster confs are told apart by their fields
@@ -22,8 +27,10 @@ findings per line with ``# netlint: disable=CODE``.
 from __future__ import annotations
 
 import argparse
+import difflib
 import os
 import sys
+import tempfile
 
 from ..config import textproto
 from ..lint import (
@@ -40,6 +47,11 @@ from ..lint import (
     sharding_rules_static,
 )
 from ..lint.ast_rules import walk_source_files
+from ..lint.cost_model import (
+    DEFAULT_COMM_FRACTION,
+    cost_rules,
+    render_cost_report,
+)
 from ..lint.net_rules import CFG000
 from ..lint.shape_rules import shape_pass
 
@@ -50,7 +62,8 @@ def _is_cluster_raw(raw: dict) -> bool:
 
 def _lint_conf(
     path: str, col: Collector, widths: dict[str, int] | None,
-    cluster_cfg=None,
+    cluster_cfg=None, comm_fraction: float = DEFAULT_COMM_FRACTION,
+    reports: list | None = None,
 ) -> None:
     try:
         with open(path, "r", encoding="utf-8") as f:
@@ -96,6 +109,96 @@ def _lint_conf(
         sharding_rules_static(
             model_cfg, widths, path, col, neuron_dims=not built
         )
+    # cost-aware shardlint (MEM001/COST001/SRV002/FLT002): the static
+    # HBM/collective/bubble model; returns the --explain-cost report
+    # when the train net built
+    report = cost_rules(
+        model_cfg, cluster_cfg, widths, path, col,
+        comm_fraction=comm_fraction,
+    )
+    if reports is not None and report is not None:
+        reports.append(report)
+
+
+def apply_fixes(
+    diags: list, *, dry_run: bool = False, out=None
+) -> int:
+    """Apply the machine-applicable ``Diagnostic.fix`` rewrites
+    (CFG001/CFG002 single-candidate did-you-means) in place; -> number
+    of fixes applied (or that WOULD apply under ``dry_run``, which
+    prints a unified diff instead of writing).
+
+    Each fix is re-verified against the file text at its recorded
+    (line, col) span before anything is touched — a quoted enum value's
+    span points at the opening quote, so a leading quote is tolerated —
+    and files are rewritten atomically (tmp + rename). Fixes land
+    bottom-up so earlier spans stay valid."""
+    if out is None:
+        # resolve at call time: binding sys.stdout as the default would
+        # pin the stream the interpreter had at import
+        out = sys.stdout
+    by_path: dict[str, list] = {}
+    for d in diags:
+        if d.fix is not None and d.code in ("CFG001", "CFG002"):
+            by_path.setdefault(d.fix.path, []).append(d.fix)
+    applied = 0
+    for path, fixes in sorted(by_path.items()):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                old_text = f.read()
+        except OSError as e:
+            print(f"--fix: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        lines = old_text.splitlines(keepends=True)
+        changed = 0
+        for fix in sorted(
+            fixes, key=lambda x: (x.line, x.col), reverse=True
+        ):
+            if not 1 <= fix.line <= len(lines):
+                continue
+            line = lines[fix.line - 1]
+            i = fix.col - 1
+            if line[i : i + len(fix.old)] != fix.old:
+                if line[i : i + 1] in "\"'" and line[
+                    i + 1 : i + 1 + len(fix.old)
+                ] == fix.old:
+                    i += 1  # quoted value: span points at the quote
+                else:
+                    continue  # text drifted since the parse: skip
+            lines[fix.line - 1] = (
+                line[:i] + fix.new + line[i + len(fix.old):]
+            )
+            changed += 1
+        if not changed:
+            continue
+        new_text = "".join(lines)
+        if dry_run:
+            out.write(
+                "".join(
+                    difflib.unified_diff(
+                        old_text.splitlines(keepends=True),
+                        new_text.splitlines(keepends=True),
+                        fromfile=path,
+                        tofile=f"{path} (fixed)",
+                    )
+                )
+            )
+        else:
+            d = os.path.dirname(os.path.abspath(path)) or "."
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(path) + ".", dir=d
+            )
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    f.write(new_text)
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                finally:
+                    raise
+        applied += changed
+    return applied
 
 
 def _collect(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
@@ -145,6 +248,32 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
     )
+    ap.add_argument(
+        "--explain-cost",
+        action="store_true",
+        help="print the per-conf cost-model report table (HBM components, "
+        "collective bytes, pipeline bubble)",
+    )
+    ap.add_argument(
+        "--cost-comm-fraction",
+        type=float,
+        default=DEFAULT_COMM_FRACTION,
+        metavar="F",
+        help="COST001 fires when modeled collective bytes exceed F x "
+        f"modeled compute bytes (default {DEFAULT_COMM_FRACTION}; "
+        "0 disables)",
+    )
+    ap.add_argument(
+        "--fix",
+        action="store_true",
+        help="apply unambiguous CFG001/CFG002 did-you-mean rewrites in "
+        "place (atomic write); with --dry-run, print the diff instead",
+    )
+    ap.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="with --fix: show the unified diff without writing",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -183,10 +312,14 @@ def main(argv: list[str] | None = None) -> int:
     cluster_real = (
         os.path.realpath(args.cluster) if args.cluster else None
     )
+    reports: list = []
     for path in confs:
         if cluster_real and os.path.realpath(path) == cluster_real:
             continue
-        _lint_conf(path, col, widths, cluster_cfg=cluster_cfg)
+        _lint_conf(
+            path, col, widths, cluster_cfg=cluster_cfg,
+            comm_fraction=args.cost_comm_fraction, reports=reports,
+        )
     if args.self_lint:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         pys.extend(walk_source_files(pkg_root, (".py",)))
@@ -203,6 +336,13 @@ def main(argv: list[str] | None = None) -> int:
         print(render_json(diags))
     elif diags:
         print(render_text(diags))
+    if args.explain_cost:
+        for report in reports:
+            print(render_cost_report(report))
+    if args.fix:
+        applied = apply_fixes(diags, dry_run=args.dry_run)
+        verb = "would apply" if args.dry_run else "applied"
+        print(f"netlint --fix: {verb} {applied} fix(es)")
     nerr = col.count("ERROR")
     nwarn = col.count("WARNING")
     if args.format == "text":
