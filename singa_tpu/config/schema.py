@@ -822,9 +822,46 @@ class FleetPeerConfig(Message):
     FIELDS = {
         "name": Field("string", required=True),
         "role": Field("enum", "unified", enum=FLEET_PEER_ROLES),
-        # reserved for real multi-host transports (today's mailbox
-        # transport needs only the shared root)
+        # the host's "host:port" endpoint under `transport: socket`
+        # (comm/wire.py; required there — netlint WIR001); the mailbox
+        # transport needs only the shared root and ignores it
         "address": Field("string", ""),
+    }
+
+
+FLEET_TRANSPORTS = ("mailbox", "socket")
+
+
+class WireConfig(Message):
+    """singa-tpu extension: the socket transport's wire discipline
+    (comm/wire.py) — send/connect deadlines, the bounded exponential
+    reconnect backoff, and the peer-liveness window the host watchdog
+    tombstones on. Only read under ``fleet { transport: socket }``;
+    every field has a serving-safe default, so an empty block works."""
+
+    FIELDS = {
+        # TCP connect deadline per attempt
+        "connect_timeout_s": Field("float", 2.0),
+        # one attempt's transmit+ack deadline; a max-size migration
+        # message must fit in it (retries re-send from scratch —
+        # netlint WIR001 checks this against link_bandwidth)
+        "send_timeout_s": Field("float", 5.0),
+        # redelivery attempts after the first (0 = single attempt)
+        "max_retries": Field("int", 4),
+        # exponential backoff base between attempts ...
+        "backoff_s": Field("float", 0.05),
+        # ... capped here (no hot reconnect loop)
+        "backoff_cap_s": Field("float", 2.0),
+        # > 0: a peer we HAVE heard from that goes silent this long is
+        # reported dead (peer_death tombstone); 0 = only exhausted
+        # sends tombstone
+        "liveness_timeout_s": Field("float", 0.0),
+        # the front door's "host:port" endpoint — finished streams
+        # report there (host.py results_to), so socket fleets need it
+        "frontdoor_address": Field("string", ""),
+        # modeled link bandwidth for WIR001's can-one-attempt-ever-
+        # deliver check; 0 disables the check
+        "link_bandwidth_bytes_per_s": Field("float", 1e9),
     }
 
 
@@ -853,6 +890,14 @@ class FleetConfig(Message):
         "prefill_hosts": Field("int", 1),
         # shared mailbox-transport root ("" = <workspace>/fleet)
         "mailbox": Field("string", ""),
+        # the cross-process wiring: "mailbox" (filesystem, the
+        # deterministic CI drill transport) or "socket" (comm/wire.py
+        # TCP — the production path; peers need address fields and the
+        # wire block's frontdoor_address, netlint WIR001)
+        "transport": Field("enum", "mailbox", enum=FLEET_TRANSPORTS),
+        # socket-transport deadlines/backoff/liveness (absent = the
+        # WireConfig defaults)
+        "wire": Field("message", message=WireConfig),
         # --- elastic fleet sizing (serve/fleet/host.py): the topology
         # (peers / nworkers) declares up to max_hosts ranks, but only
         # ranks [0, min_hosts) must be live at launch — the rest are

@@ -41,6 +41,7 @@ from .net_rules import (  # noqa: F401
     lint_model_text,
     ring_rules,
     sharding_rules_static,
+    wire_rules,
 )
 from .shape_rules import shape_pass  # noqa: F401
 from .ast_rules import lint_python_file, lint_python_tree  # noqa: F401

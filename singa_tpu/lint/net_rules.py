@@ -101,6 +101,13 @@ ELA001 = rule(
     "has fewer elements than the target axis width — beyond even the "
     "pad/replicate fallback)",
 )
+WIR001 = rule(
+    "WIR001",
+    ERROR,
+    "socket transport misconfigured: missing/duplicate peer or "
+    "frontdoor addresses, non-positive wire timeouts/backoff, or a "
+    "send deadline that cannot cover one max-size migration message",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -673,6 +680,157 @@ def fleet_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
         )
 
 
+def wire_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
+    """WIR001 — static mirrors of the socket transport's launch
+    rejections and its one silent-degradation mode (comm/wire.py,
+    selected by ``fleet { transport: socket }``). Arms, reported
+    independently:
+
+    (a) addressing the factory rejects at launch
+        (serve/fleet/host._build_transport): no ``peers`` entries at
+        all (a socket fleet has no runtime discovery — the address map
+        IS the topology), a peers entry with an empty ``address``, two
+        entries binding the SAME address (the second register's bind
+        raises mid-launch, after the first host is already up), and a
+        missing ``wire.frontdoor_address`` (the router/driver endpoint
+        cannot be auto-bound across OS processes).
+    (b) wire knobs that disable the retry machinery instead of tuning
+        it: non-positive connect/send timeouts or backoff_s (a zero
+        deadline times out every frame; a zero backoff is the hot
+        reconnect loop the transport exists to prevent), negative
+        max_retries.
+    (c) a send deadline that cannot cover ONE max-size migration
+        message: a retry re-sends the whole frame from scratch, so if
+        ``send_timeout_s`` < the bulk npz migration's transfer time at
+        the declared ``wire.link_bandwidth_bytes_per_s``, EVERY attempt
+        times out mid-frame and the retry budget burns to a false
+        peer-death tombstone — the one failure mode that looks like a
+        network fault but is pure configuration. Skipped when the
+        window/geometry is not statically decidable (SRV001's
+        convention) or link_bandwidth_bytes_per_s is 0 (unset)."""
+    fleet = getattr(model_cfg, "fleet", None)
+    if fleet is None or fleet.transport != "socket":
+        return
+    wire = fleet.wire
+    peers = fleet.peers or []
+    if not peers:
+        col.emit(
+            WIR001,
+            path,
+            "transport: socket with no peers entries — the address map "
+            "IS the topology (no runtime discovery), so the launch "
+            "rejects before any host binds",
+            fix_hint="declare every host as peers { name: ... role: "
+            "... address: \"host:port\" }",
+        )
+    unaddressed = [p.name for p in peers if not p.address]
+    if unaddressed:
+        col.emit(
+            WIR001,
+            path,
+            f"transport: socket peers without an address: "
+            f"{', '.join(unaddressed)} — the launch rejects before any "
+            "host binds (mailbox infers endpoints from the shared "
+            "root; sockets cannot)",
+            fix_hint="give every peers entry address: \"host:port\"",
+        )
+    seen_addr: dict[str, str] = {}
+    frontdoor = wire.frontdoor_address if wire is not None else ""
+    if frontdoor:
+        seen_addr[frontdoor] = "wire.frontdoor_address"
+    for p in peers:
+        if not p.address:
+            continue
+        if p.address in seen_addr:
+            col.emit(
+                WIR001,
+                path,
+                f"peers entry {p.name!r} binds address {p.address!r} "
+                f"already claimed by {seen_addr[p.address]} — the "
+                "second register's bind raises mid-launch, after the "
+                "first host is already up",
+                fix_hint="give every endpoint a distinct host:port",
+            )
+        else:
+            seen_addr[p.address] = f"peers entry {p.name!r}"
+    if peers and not frontdoor:
+        col.emit(
+            WIR001,
+            path,
+            "transport: socket without wire.frontdoor_address — the "
+            "front-door router/driver endpoint cannot be auto-bound "
+            "across OS processes, so hosts cannot return results or "
+            "hand back drained sequences",
+            fix_hint='add wire { frontdoor_address: "host:port" }',
+        )
+    if wire is None:
+        return
+    for knob, val in (
+        ("connect_timeout_s", wire.connect_timeout_s),
+        ("send_timeout_s", wire.send_timeout_s),
+        ("backoff_s", wire.backoff_s),
+    ):
+        if val is not None and val <= 0:
+            col.emit(
+                WIR001,
+                path,
+                f"wire.{knob} {val:g} <= 0 — a zero deadline times out "
+                "every frame and a zero backoff is the hot reconnect "
+                "loop the transport exists to prevent",
+                fix_hint=f"set wire.{knob} > 0 (or omit for the "
+                "default)",
+            )
+    if wire.max_retries is not None and wire.max_retries < 0:
+        col.emit(
+            WIR001,
+            path,
+            f"wire.max_retries {wire.max_retries} < 0 — the retry "
+            "budget cannot be negative (0 means single-attempt)",
+            fix_hint="set wire.max_retries >= 0 (or omit for the "
+            "default)",
+        )
+    # (c) the deadline-vs-migration-size budget. A migration frame is
+    # the sequence's whole serving state as ONE bulk message (gather,
+    # wire, scatter): K and V per attention layer across every block a
+    # max-length sequence touches, plus its token lane — sized from the
+    # same declared geometry kernel_rules reads
+    bw = wire.link_bandwidth_bytes_per_s
+    timeout = wire.send_timeout_s
+    srv = getattr(model_cfg, "serving", None)
+    if not bw or bw <= 0 or not timeout or timeout <= 0 or srv is None:
+        return
+    from .cost_model import _attention_geometry  # lazy: cost_model
+    # imports _declared_window from this module
+
+    n_layers, heads, head_dim = _attention_geometry(model_cfg)
+    window = _declared_window(model_cfg)
+    if not (n_layers and heads and head_dim and window):
+        return  # geometry not statically decidable: nothing to budget
+    block_len = max(1, srv.kv_block_len)
+    n_blocks = -(-window // block_len)
+    msg_bytes = (
+        2 * n_layers * heads * n_blocks * block_len * head_dim * 4
+        + window * 4  # token lane (i32)
+        + 4096  # npz/header overhead
+    )
+    need_s = msg_bytes / bw
+    if timeout < need_s:
+        col.emit(
+            WIR001,
+            path,
+            f"wire.send_timeout_s {timeout:g} cannot cover one "
+            f"max-size migration message: ~{msg_bytes} bytes "
+            f"({n_layers} layers x {heads} heads x {n_blocks} blocks "
+            f"x {block_len} x {head_dim} K+V f32, window {window}) at "
+            f"link_bandwidth_bytes_per_s {bw:g} needs ~{need_s:.2f}s "
+            "per attempt — retries re-send from scratch, so every "
+            "attempt times out mid-frame and the budget burns to a "
+            "false peer-death tombstone",
+            fix_hint=f"set wire.send_timeout_s >= {need_s:.2f} or "
+            "declare the real link bandwidth",
+        )
+
+
 def elastic_rules(
     model_cfg: ModelConfig,
     widths: dict[str, int] | None,
@@ -1090,6 +1248,7 @@ def lint_model_text(
     graph_rules(model_cfg, path, col)
     serving_rules(model_cfg, path, col)
     fleet_rules(model_cfg, path, col)
+    wire_rules(model_cfg, path, col)
     kernel_rules(model_cfg, path, col)
     if widths:
         sharding_rules_static(model_cfg, widths, path, col)

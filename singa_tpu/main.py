@@ -92,6 +92,7 @@ def main(argv: list[str] | None = None) -> int:
 
         return run_from_conf(
             model_cfg, cluster_cfg, procs_id=args.procsID, seed=args.seed,
+            faults=args.faults,
         )
     init_distributed(args.procsID, args.hostfile)
     # persistent-compile warm start: repeat runs skip XLA recompilation

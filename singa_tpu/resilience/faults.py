@@ -25,6 +25,12 @@ from the ``-faults`` CLI flag or the ``SINGA_TPU_FAULTS`` env var:
                    Exercises the zero-stall pipeline's crash safety
                    (resilience/async_ckpt.py): LATEST must keep naming
                    the previous complete save
+  wire_drop@3      drop the 3rd transport send's first attempt on the
+                   wire (comm/faults.py; also wire_delay@K:ms=N,
+                   wire_dup@K, wire_torn@K, wire_partition@K[=S]
+                   [:peer=H]) — the K here is a message-send ORDINAL,
+                   not a step: the socket transport's retry/redeliver/
+                   tombstone verdicts are the drill target
   profile@20:steps=5  not a fault at all — the profiler TRIGGER rides
                    the same plumbing (step-keyed, fire-once, rank-
                    targetable, forces per-step boundaries): bracket
@@ -86,6 +92,15 @@ KINDS = (
     "slowstep",
     "async_torn_write",
     "profile",
+    # wire faults (comm/faults.py): keyed on message-send ORDINALS,
+    # not steps — `at` is the K-th transport send this process makes.
+    # ``wire_delay@K:ms=N`` stalls N ms; ``:peer=H`` scopes a term to
+    # sends addressed to H (or names a partition's victim)
+    "wire_drop",
+    "wire_delay",
+    "wire_dup",
+    "wire_torn",
+    "wire_partition",
 )
 
 #: kinds triggered by step number at the pre-step boundary seam
@@ -110,22 +125,28 @@ def tear_file(path: str) -> None:
 
 @dataclasses.dataclass
 class FaultSpec:
-    """One ``kind@at[=value][:steps=N][:rank=K]`` term; ``fired`` flips
-    on injection. ``rank=None`` means every process; ``steps`` is the
-    profile trigger's bracket length (None elsewhere)."""
+    """One ``kind@at[=value][:ms=N][:steps=N][:peer=H][:rank=K]`` term;
+    ``fired`` flips on injection. ``rank=None`` means every process;
+    ``steps`` is the profile trigger's bracket length, ``ms`` the
+    wire_delay stall, ``peer`` a wire term's target host (None
+    elsewhere)."""
 
     kind: str
     at: int
     value: float | None = None
     rank: int | None = None
     steps: int | None = None
+    ms: int | None = None
+    peer: str | None = None
     fired: bool = False
 
     def __str__(self) -> str:
         v = "" if self.value is None else f"={self.value:g}"
+        m = "" if self.ms is None else f":ms={self.ms}"
         s = "" if self.steps is None else f":steps={self.steps}"
+        p = "" if self.peer is None else f":peer={self.peer}"
         r = "" if self.rank is None else f":rank={self.rank}"
-        return f"{self.kind}@{self.at}{v}{s}{r}"
+        return f"{self.kind}@{self.at}{v}{m}{s}{p}{r}"
 
 
 class FaultPlan:
@@ -150,13 +171,23 @@ class FaultPlan:
             body, *quals = term.split(":")
             rank = None
             steps = None
+            ms = None
+            peer = None
             for qual in quals:
                 qkey, qsep, qval = qual.partition("=")
-                if qkey not in ("rank", "steps") or not qsep:
+                if qkey not in ("rank", "steps", "ms", "peer") or not qsep:
                     raise FaultPlanError(
                         f"fault term {term!r}: unknown qualifier "
-                        f"{qual!r} (expected ':rank=K' or ':steps=N')"
+                        f"{qual!r} (expected ':rank=K', ':steps=N', "
+                        "':ms=N' or ':peer=H')"
                     )
+                if qkey == "peer":
+                    if not qval:
+                        raise FaultPlanError(
+                            f"fault term {term!r}: empty peer name"
+                        )
+                    peer = qval
+                    continue
                 try:
                     qint = int(qval)
                 except ValueError:
@@ -170,6 +201,12 @@ class FaultPlan:
                             f"fault term {term!r}: negative rank"
                         )
                     rank = qint
+                elif qkey == "ms":
+                    if qint < 0:
+                        raise FaultPlanError(
+                            f"fault term {term!r}: negative ms"
+                        )
+                    ms = qint
                 else:
                     if qint < 1:
                         raise FaultPlanError(
@@ -208,22 +245,40 @@ class FaultPlan:
                     f"fault term {term!r}: ':steps=N' only applies to "
                     "profile triggers"
                 )
-            specs.append(FaultSpec(kind, at_n, value, rank, steps))
+            if ms is not None and kind != "wire_delay":
+                raise FaultPlanError(
+                    f"fault term {term!r}: ':ms=N' only applies to "
+                    "wire_delay terms"
+                )
+            if peer is not None and not kind.startswith("wire_"):
+                raise FaultPlanError(
+                    f"fault term {term!r}: ':peer=H' only applies to "
+                    "wire_* terms"
+                )
+            specs.append(FaultSpec(kind, at_n, value, rank, steps, ms, peer))
         return cls(specs)
 
     def __bool__(self) -> bool:
         return bool(self.specs)
 
-    def fire(self, kind: str, at: int) -> FaultSpec | None:
+    def fire(self, kind: str, at: int, *, peer: str | None = None
+             ) -> FaultSpec | None:
         """The unfired ``kind@at`` spec, marked fired — or None.
 
         Rank-qualified specs only fire on their target process; on any
         other rank they stay unfired (the qualifier scopes the fault,
-        it must not be consumed by the ranks it skips)."""
+        it must not be consumed by the ranks it skips). ``peer``-
+        qualified wire specs likewise fire only when the caller's
+        ``peer`` (the send's destination) matches."""
         for spec in self.specs:
             if spec.kind != kind or spec.at != at or spec.fired:
                 continue
             if spec.rank is not None and spec.rank != _process_index():
+                continue
+            if (
+                spec.peer is not None and peer is not None
+                and spec.peer != peer
+            ):
                 continue
             spec.fired = True
             # profile is documented as NOT a fault — it gets its own

@@ -26,7 +26,12 @@ rank) fronted by its Router tier (include/utils/router.h:16-57):
   ``transport``  one-shot messages + latest-wins status, in-process
                  (deterministic drills) or filesystem mailboxes
                  (cross-OS-process, atomic tmp+rename — the commit
-                 markers' discipline at message grain).
+                 markers' discipline at message grain). The PRODUCTION
+                 wiring of the same seam is ``comm.wire``'s TCP
+                 ``SocketTransport`` (``fleet { transport: socket }``):
+                 CRC'd frames, bounded-backoff retries, at-least-once
+                 redelivery the importer dedupes, and peer-death
+                 tombstones when a wire stays dead.
 
 ``tools/serve_bench.py --fleet`` is the load harness and CI gate;
 ``python -m singa_tpu.main`` with a ``fleet {}`` conf block launches

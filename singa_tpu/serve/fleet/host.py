@@ -45,6 +45,7 @@ import time
 
 import numpy as np
 
+from ...comm.wire import WireError
 from ...resilience.preemption import EXIT_RESUMABLE
 from ..engine import Engine, EngineConfig
 from ..kv_pool import PoolExhausted
@@ -170,6 +171,11 @@ class FleetHost:
         self._digest_hex: tuple[int, list[str]] = (-1, [])
         #: rotation cursor for load-score ties (_pick_peer)
         self._rr = 0
+        #: peers the wire tombstoned (peer_death): excluded from every
+        #: placement until the transport reports them healed — the
+        #: liveness watchdog's verdict set (socket transport only; the
+        #: mailbox/local wirings never raise WireError)
+        self._dead: set[str] = set()
         self.migrate_in = 0
         self.migrate_out = 0
         self.blocks_in = 0
@@ -212,13 +218,14 @@ class FleetHost:
         out = [
             s for h, s in published.items()
             if s.get("role") in roles and h != exclude
+            and h not in self._dead
         ]
         out.sort(key=load_score)
         out.extend(
             {"host": n, "role": r}
             for n, r in sorted(self.peers.items())
             if r in roles and n not in published and n != exclude
-            and n not in self._latent
+            and n not in self._latent and n not in self._dead
         )
         return out
 
@@ -229,6 +236,11 @@ class FleetHost:
         observable too)."""
         for h, s in published.items():
             role = s.get("role")
+            if h in self._dead:
+                # a tombstoned peer's LAST status lingers in the store;
+                # only the wire healing it (_note_peer_deaths) may
+                # re-admit it, never its stale snapshot
+                continue
             if h in self._latent and role in ROLES:
                 self._latent.discard(h)
                 self._event("fleet_join", host=h, role=role)
@@ -244,6 +256,82 @@ class FleetHost:
                 self._event("fleet_leave", host=h)
                 self.log(f"fleet host {self.name}: peer {h!r} left "
                          "(drained)")
+
+    def _mark_dead(self, peer: str, reason: str) -> None:
+        """The loud tombstone: a peer whose wire exhausted a send's
+        retry budget leaves every candidate set NOW (waiting on it
+        would strand sequences behind a dead endpoint). It re-latents
+        too — if it ever heals, its next serving status is a fresh
+        ``fleet_join``, the elastic rejoin path."""
+        if peer in self._dead or peer not in self.peers:
+            return
+        self._dead.add(peer)
+        self._latent.add(peer)
+        self._event("peer_death", peer=peer, via="wire", reason=reason)
+        self.log(
+            f"fleet host {self.name}: peer {peer!r} unreachable "
+            f"({reason}) — tombstoned"
+        )
+
+    def _note_peer_deaths(self) -> None:
+        """Reconcile with the transport's liveness view each tick
+        (socket transport's ``dead_peers``; the mailbox/local wirings
+        have no liveness view and skip). New suspects tombstone; a
+        healed peer (successful send or fresh status) drops its
+        tombstone and waits in ``_latent`` for its join announce."""
+        dead_fn = getattr(self.transport, "dead_peers", None)
+        if dead_fn is None:
+            return
+        now_dead = {p for p in dead_fn() if p in self.peers}
+        for p in sorted(now_dead - self._dead):
+            self._mark_dead(p, "wire liveness")
+        for p in self._dead - now_dead:
+            self._dead.discard(p)
+
+    def _export_with_failover(self, slot: int, req) -> str | None:
+        """Export to the least-loaded decode-capable peer, tombstoning
+        any whose wire fails and re-placing until one takes it or no
+        candidate remains. The send happens BEFORE the slot retires
+        (_export_to), so a failed attempt leaves the sequence intact
+        in its slot — nothing is ever half-exported."""
+        tried: set[str] = set()
+        while True:
+            dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
+            if dst is None or dst in tried:
+                return None
+            try:
+                self._export_to(slot, req, dst)
+                return dst
+            except WireError as e:
+                tried.add(dst)
+                self._mark_dead(dst, str(e))
+
+    def _send_with_failover(self, roles, kind: str,
+                            payload: bytes) -> str | None:
+        """One self-contained message to the least-loaded capable peer,
+        with the same tombstone-and-re-place discipline."""
+        tried: set[str] = set()
+        while True:
+            dst = self._pick_peer(roles, exclude=self.name)
+            if dst is None or dst in tried:
+                return None
+            try:
+                self.transport.send(dst, kind, payload, src=self.name)
+                return dst
+            except WireError as e:
+                tried.add(dst)
+                self._mark_dead(dst, str(e))
+
+    def _marooned(self) -> bool:
+        """A split-role host whose EVERY declared counterpart is
+        tombstoned can neither finish nor start a stream — the verdict
+        is a loud drain (hand-back accounting) + EXIT_RESUMABLE, never
+        a silent idle loop behind a dead wire."""
+        if self.role == "unified" or not self._dead:
+            return False
+        need = DECODE_CAPABLE if self.role == "prefill" else PREFILL_CAPABLE
+        capable = {n for n, r in self.peers.items() if r in need}
+        return bool(capable) and capable <= self._dead
 
     def _pick_peer(self, roles, exclude: str | None = None) -> str | None:
         """Least-loaded target, rotating among score TIES: published
@@ -267,6 +355,7 @@ class FleetHost:
         role-gated scheduler tick, export filled sequences (prefill
         role), publish fresh status. -> tokens emitted."""
         self._recv()
+        self._note_peer_deaths()
         self._import_pending()
         emitted = self.sched.tick()
         if self.role == "prefill":
@@ -291,14 +380,17 @@ class FleetHost:
                     self.log(f"fleet host {self.name}: rejected "
                              f"request {req.rid}: {e}")
                     if self.results_to is not None:
-                        self.transport.send(
-                            self.results_to, "result",
-                            json.dumps({
-                                "rid": req.rid, "tokens": [],
-                                "host": self.name, "error": str(e),
-                            }).encode("utf-8"),
-                            src=self.name,
-                        )
+                        try:
+                            self.transport.send(
+                                self.results_to, "result",
+                                json.dumps({
+                                    "rid": req.rid, "tokens": [],
+                                    "host": self.name, "error": str(e),
+                                }).encode("utf-8"),
+                                src=self.name,
+                            )
+                        except WireError:
+                            pass  # front door gone too; verdict logged
             elif msg.kind == "migrate":
                 self._pending.append(
                     (migrate.deserialize(msg.payload), msg.src)
@@ -367,10 +459,8 @@ class FleetHost:
             req = self.sched._slot_req[slot]
             if req.status != "decoding":
                 continue
-            dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
-            if dst is None:
+            if self._export_with_failover(slot, req) is None:
                 break
-            self._export_to(slot, req, dst)
 
     def _export_to(self, slot: int, req: Request, dst: str) -> None:
         mseq = migrate.export_sequence(self.engine, req, slot)
@@ -399,19 +489,27 @@ class FleetHost:
             self.sched.finished[self._flushed:],
             len(self.sched.finished),
         )
-        for req in new:
+        for idx, req in enumerate(new):
             if req.rid in self._reported:
                 continue
             self._reported.add(req.rid)
-            self.transport.send(
-                self.results_to, "result",
-                json.dumps({
-                    "rid": req.rid,
-                    "tokens": [int(t) for t in req.tokens],
-                    "host": self.name,
-                }).encode("utf-8"),
-                src=self.name,
-            )
+            try:
+                self.transport.send(
+                    self.results_to, "result",
+                    json.dumps({
+                        "rid": req.rid,
+                        "tokens": [int(t) for t in req.tokens],
+                        "host": self.name,
+                    }).encode("utf-8"),
+                    src=self.name,
+                )
+            except WireError:
+                # the front door is unreachable: rewind so this result
+                # and everything after it retry next tick — a finished
+                # stream is never silently unreported
+                self._reported.discard(req.rid)
+                self._flushed -= len(new) - idx
+                break
 
     # -- status feedback ------------------------------------------------
 
@@ -471,33 +569,31 @@ class FleetHost:
         for slot in sorted(self.sched._slot_req):
             req = self.sched._slot_req[slot]
             if req.status == "decoding":
-                dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
+                dst = self._export_with_failover(slot, req)
                 if dst is not None:
                     self._event(
                         "evict", rid=req.rid, slot=slot, state="migrated",
                         tokens_done=len(req.tokens), dst=dst,
                     )
-                    self._export_to(slot, req, dst)
                     migrated.append(
                         {"rid": req.rid, "dst": dst,
                          "tokens_done": len(req.tokens)}
                     )
                     continue
-            dst = self._pick_peer(PREFILL_CAPABLE, exclude=self.name)
+            from .router import encode_request
+
             self.engine.retire(slot)
             del self.sched._slot_req[slot]
             req.status = "evicted"
+            dst = self._send_with_failover(
+                PREFILL_CAPABLE, "request", encode_request(req)
+            )
             state = "forwarded" if dst is not None else "in_flight"
             self._event(
                 "evict", rid=req.rid, slot=slot, state=state,
                 tokens_done=len(req.tokens), prefilled=req._prefilled,
             )
             if dst is not None:
-                from .router import encode_request
-
-                self.transport.send(
-                    dst, "request", encode_request(req), src=self.name,
-                )
                 forwarded.append({"rid": req.rid, "dst": dst})
             else:
                 handed_back.append(
@@ -520,13 +616,12 @@ class FleetHost:
         ]
         self._pending.clear()
         for req in list(self.sched._queue) + pending_reqs:
-            dst = self._pick_peer(PREFILL_CAPABLE, exclude=self.name)
-            if dst is not None:
-                from .router import encode_request
+            from .router import encode_request
 
-                self.transport.send(
-                    dst, "request", encode_request(req), src=self.name,
-                )
+            dst = self._send_with_failover(
+                PREFILL_CAPABLE, "request", encode_request(req)
+            )
+            if dst is not None:
                 forwarded.append({"rid": req.rid, "dst": dst})
             else:
                 handed_back.append({"rid": req.rid, "tokens_done": 0})
@@ -566,11 +661,10 @@ class FleetHost:
         accounting), a request keeps its stamp semantics."""
         if msg.kind == "migrate":
             mseq = migrate.deserialize(msg.payload)
-            dst = self._pick_peer(DECODE_CAPABLE, exclude=self.name)
+            dst = self._send_with_failover(
+                DECODE_CAPABLE, "migrate", msg.payload
+            )
             if dst is not None:
-                self.transport.send(
-                    dst, "migrate", msg.payload, src=self.name,
-                )
                 self._event(
                     "migrate_out", rid=mseq.rid, dst=dst, slot=-1,
                     blocks=mseq.n_blocks, bytes=len(msg.payload),
@@ -587,11 +681,10 @@ class FleetHost:
                 )
         elif msg.kind == "request":
             req = decode_request(msg.payload)
-            dst = self._pick_peer(PREFILL_CAPABLE, exclude=self.name)
+            dst = self._send_with_failover(
+                PREFILL_CAPABLE, "request", msg.payload
+            )
             if dst is not None:
-                self.transport.send(
-                    dst, "request", msg.payload, src=self.name,
-                )
                 forwarded.append({"rid": req.rid, "dst": dst})
             else:
                 handed_back.append({"rid": req.rid, "tokens_done": 0})
@@ -616,6 +709,16 @@ class FleetHost:
                 )
                 return EXIT_RESUMABLE, acct
             emitted = self.tick()
+            if self._marooned():
+                # the wire tombstoned EVERY counterpart this split-role
+                # host has: serving cannot proceed — drain loudly
+                # (hand-back accounting, no capable peer left to take
+                # the work) and exit resumable, never idle silently
+                acct = self.drain(
+                    "wire: no capable peer reachable",
+                    grace_s=drain_grace_s,
+                )
+                return EXIT_RESUMABLE, acct
             if self.busy or emitted:
                 idle_since = None
                 continue
@@ -674,20 +777,70 @@ def lm_config_from_conf(model_cfg):
     )
 
 
+def _build_transport(fleet, root: str, recorder, faults: str | None,
+                     log=print):
+    """The transport seam's factory: ``fleet { transport }`` picks the
+    filesystem mailbox (deterministic CI drills; default) or the real
+    socket wire (comm/wire.py — the production path). Socket fleets
+    dial peers by their conf addresses (+ the wire block's
+    frontdoor_address for the results endpoint) and may carry a
+    ``-faults`` wire-fault plan; missing addresses reject here, before
+    any host serves (netlint WIR001 flags them statically)."""
+    if getattr(fleet, "transport", "mailbox") != "socket":
+        from .transport import Mailbox
+
+        return Mailbox(root)
+    from ...comm.faults import WIRE_KINDS, WireFaults
+    from ...comm.wire import SocketTransport
+    from ...config.schema import WireConfig
+    from ...resilience.faults import FaultPlan
+
+    wire = fleet.wire if fleet.wire is not None else WireConfig()
+    addresses = {p.name: p.address for p in fleet.peers if p.address}
+    missing = [p.name for p in fleet.peers if not p.address]
+    if not fleet.peers or missing:
+        raise ValueError(
+            "fleet transport: socket needs an address on every peers "
+            f"entry; missing on {missing or '(no peers declared)'} "
+            "(netlint WIR001 flags this statically)"
+        )
+    if wire.frontdoor_address:
+        addresses[FRONTDOOR] = wire.frontdoor_address
+    wf = None
+    plan = FaultPlan.parse(faults)
+    if any(s.kind in WIRE_KINDS for s in plan.specs):
+        wf = WireFaults(plan)
+        log(f"wire-fault plan armed: {plan}")
+    return SocketTransport(
+        addresses,
+        connect_timeout_s=wire.connect_timeout_s,
+        send_timeout_s=wire.send_timeout_s,
+        max_retries=wire.max_retries,
+        backoff_s=wire.backoff_s,
+        backoff_cap_s=wire.backoff_cap_s,
+        liveness_timeout_s=wire.liveness_timeout_s,
+        recorder=recorder,
+        faults=wf,
+    )
+
+
 def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
-                  seed: int = 0, log=print) -> int:
+                  seed: int = 0, faults: str | None = None,
+                  log=print) -> int:
     """The ``fleet {}`` dispatch target of ``singa_tpu.main``: build
     this rank's engine, take the role ``role_for_rank`` assigns, wire
-    the workspace mailbox, and serve until shutdown / SIGTERM (exit 75
-    after a drain-to-peer). The launch line is the reference's
-    (``-procsID k`` per host); no jax.distributed rendezvous is needed
-    — fleet hosts share nothing but the mailbox."""
+    the transport the conf picks (mailbox or socket), and serve until
+    shutdown / SIGTERM (exit 75 after a drain-to-peer). The launch
+    line is the reference's (``-procsID k`` per host); no
+    jax.distributed rendezvous is needed — fleet hosts share nothing
+    but the transport. ``faults`` carries the ``-faults`` plan so
+    wire-fault drills (wire_drop@K etc.) run through the same launch
+    line as training fault drills."""
     import jax
 
     from ...models.transformer import init_lm
     from ...obs.recorder import FlightRecorder
     from ...resilience.preemption import PreemptionHandler
-    from .transport import Mailbox
 
     fleet = model_cfg.fleet
     n_hosts = len(fleet.peers) or (
@@ -753,10 +906,11 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
     )
     handler = PreemptionHandler()
     handler.install()
+    transport = _build_transport(fleet, root, recorder, faults, log=log)
     log(f"fleet host {name!r} (rank {procs_id}): role {role}, "
-        f"mailbox {root}")
+        f"transport {getattr(fleet, 'transport', 'mailbox')} ({root})")
     host = FleetHost(
-        name, role, engine, Mailbox(root),
+        name, role, engine, transport,
         peers={n: r for n, r in topo if n != name},
         latent=latent - {name},
         recorder=recorder, preemption=handler,
@@ -765,6 +919,9 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
     rc, acct = host.serve_forever()
     if acct is not None:
         log("FLEET DRAIN: " + json.dumps(acct))
+    close = getattr(transport, "close", None)
+    if close is not None:
+        close()
     recorder.event("run_stop", step=host.sched.ticks, exit_code=rc)
     recorder.close()
     return rc

@@ -3,8 +3,11 @@
 The fleet never RPCs (arxiv 1805.08430's complaint): hosts exchange
 self-contained one-shot messages — a migrated sequence, a forwarded
 request, a shutdown — and publish latest-wins status snapshots the
-router's occupancy feedback reads. Two interchangeable wirings behind
-one API:
+router's occupancy feedback reads. Three interchangeable wirings behind
+one API (the third, ``comm.wire.SocketTransport``, is the production
+TCP path — CRC'd frames, retry/backoff, at-least-once redelivery with
+dedupe — selected by ``fleet { transport: socket }``; this module holds
+the two deterministic drill wirings):
 
   ``LocalTransport``   in-process deques: the serve_bench ``--fleet``
       drill and the unit tests run a whole multi-host fleet in one

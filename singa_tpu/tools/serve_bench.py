@@ -157,6 +157,23 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--fleet_threshold", type=float, default=1.5,
                     help="min fleet tokens/sec over the single host "
                     "(or-gated with the role-split proof)")
+    ap.add_argument("--transport", default="local",
+                    choices=("local", "mailbox", "socket"),
+                    help="with --fleet: the wiring under the hosts. "
+                    "'local' = in-process deques (the deterministic "
+                    "drill), 'mailbox' = filesystem mailboxes, "
+                    "'socket' = the production TCP path (comm/wire.py "
+                    "over loopback: real frames, CRCs, acks, retries). "
+                    "Streams must match the single host on EVERY "
+                    "wiring; socket/mailbox also report migration "
+                    "round-trip latency and router status staleness")
+    ap.add_argument("--wire_faults", default=None,
+                    help="with --transport socket: a wire-fault plan "
+                    "(resilience/faults.py grammar), e.g. "
+                    "'wire_drop@12,wire_torn@18,wire_dup@24' — "
+                    "ordinals count MSG sends across the transport; "
+                    "the fleet must still finish with matching "
+                    "streams, proving retry/redeliver/dedupe")
     ap.add_argument("--sigterm_host", default=None,
                     help="with --fleet and --sigterm_at_tick: the host "
                     "(by name, or by role = its first host) whose "
@@ -463,6 +480,60 @@ def run_poisson(params, cfg, prompts, args, recorder=None):
     return sched, elapsed, lat_ms
 
 
+class _TimedSend:
+    """Transport proxy that times ``migrate`` sends (submit -> the
+    transport's own done signal: for the socket wiring that is the
+    receiver's ACK, i.e. the migration round trip). Everything else
+    forwards untouched, so hosts/router never know it is there."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.migrate_ms: list[float] = []
+
+    def send(self, dst, kind, payload, *, src):
+        t0 = time.perf_counter()
+        self._inner.send(dst, kind, payload, src=src)
+        if kind == "migrate":
+            self.migrate_ms.append((time.perf_counter() - t0) * 1e3)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _build_transport_arm(args):
+    """The --transport wiring for the fleet drill: None for 'local'
+    (build_fleet's default), a shared-root Mailbox, or a loopback
+    SocketTransport (auto-bound ports; --wire_faults armed)."""
+    arm = getattr(args, "transport", "local")
+    if arm == "local":
+        return None
+    if arm == "mailbox":
+        import os
+        import tempfile
+
+        from ..serve.fleet import Mailbox
+
+        root = (
+            os.path.join(args.workspace, "mailbox")
+            if args.workspace
+            else tempfile.mkdtemp(prefix="serve_bench_mbx_")
+        )
+        return Mailbox(root)
+    from ..comm import SocketTransport, WireFaults
+    from ..resilience.faults import FaultPlan
+
+    faults = None
+    if args.wire_faults:
+        faults = WireFaults(FaultPlan.parse(args.wire_faults))
+    # generous RETRY budget, tight per-attempt deadline: injected
+    # drops/torn frames must end in redelivery, not a tombstone — and a
+    # dropped frame costs one deadline, not five seconds of bench time
+    return SocketTransport(
+        connect_timeout_s=2.0, send_timeout_s=1.0, max_retries=6,
+        backoff_s=0.02, backoff_cap_s=0.25, faults=faults,
+    )
+
+
 def build_fleet(params, cfg, args, *, transport=None):
     """Hosts (one engine each) + router per ``--fleet_hosts``, wired
     over an in-process transport — the whole multi-host fleet in one
@@ -512,12 +583,22 @@ def run_fleet(params, cfg, prompts, args, *, recorders=None,
     target host's preemption plane at that fleet round — it drains to
     a PEER and the fleet finishes without it. -> (hosts, router,
     elapsed_s, streams {rid: tokens}, queue-inclusive latencies ms,
-    drain accounting | None)."""
+    drain accounting | None, wire report | None). The wire report
+    (non-local --transport only) carries migration round-trip
+    latencies, router status-staleness samples, and (socket) the
+    transport's retry/redelivery counters."""
     import numpy as np
 
     from ..serve import Request
 
-    hosts, router, _ = build_fleet(params, cfg, args)
+    wire_arm = _build_transport_arm(args)
+    timed = _TimedSend(wire_arm) if wire_arm is not None else None
+    if timed is not None and recorders:
+        # attach BEFORE warmup: connections are cached, so the
+        # wire_connect events a trace reconstruction needs fire during
+        # the warm waves
+        wire_arm.recorder = recorders[0]
+    hosts, router, _ = build_fleet(params, cfg, args, transport=timed)
     by_name = {h.name: h for h in hosts}
     if sigterm_at_tick:
         if sigterm_target in by_name:
@@ -587,6 +668,11 @@ def run_fleet(params, cfg, prompts, args, *, recorders=None,
     rids = set(range(len(prompts)))
     tick = 0
     idle_rounds = 0
+    # router status staleness: how old each host's latest-wins status
+    # snapshot is when the placement loop reads it (sampled every few
+    # rounds; a change resets that host's clock)
+    stale_ms: list[float] = []
+    stale_last: dict[str, tuple[dict, float]] = {}
     t0 = time.perf_counter()
     while True:
         now = time.perf_counter() - t0
@@ -623,6 +709,14 @@ def run_fleet(params, cfg, prompts, args, *, recorders=None,
                 "fleet stalled with requests unfinished: "
                 f"{sorted(rids - finished)}"
             )
+        if timed is not None and tick % 5 == 0:
+            snap_t = time.perf_counter()
+            for hname, st in timed.statuses().items():
+                prev = stale_last.get(hname)
+                if prev is None or prev[0] != st:
+                    stale_last[hname] = (st, snap_t)
+                else:
+                    stale_ms.append((snap_t - prev[1]) * 1e3)
         if not busy and pending:
             time.sleep(min(max(pending[0][0] - now, 0.0), 0.01))
         tick += 1
@@ -635,7 +729,18 @@ def run_fleet(params, cfg, prompts, args, *, recorders=None,
         (r.finish_mono - r.enqueue_mono) * 1e3
         for h in hosts for r in h.sched.finished if r.rid >= 0
     )
-    return hosts, router, elapsed, streams, lat_ms, acct
+    wire = None
+    if timed is not None:
+        stats = getattr(wire_arm, "wire_stats", None)
+        wire = {
+            "migrate_rtt_ms": sorted(timed.migrate_ms),
+            "status_staleness_ms": sorted(stale_ms),
+            "stats": stats() if stats is not None else None,
+        }
+        close = getattr(wire_arm, "close", None)
+        if close is not None:
+            close()
+    return hosts, router, elapsed, streams, lat_ms, acct, wire
 
 
 def _fleet_main(args, params, cfg, prompts) -> int:
@@ -672,7 +777,7 @@ def _fleet_main(args, params, cfg, prompts) -> int:
     )
     base = {r.rid: list(r.tokens) for r in base_sched.finished}
     base_tokens = base_sched.tokens_emitted + len(base_sched.finished)
-    hosts, router, elapsed, streams, lat_ms, acct = run_fleet(
+    hosts, router, elapsed, streams, lat_ms, acct, wire = run_fleet(
         params, cfg, prompts, args,
         recorders=recorders, router_recorder=router_rec,
         sigterm_at_tick=args.sigterm_at_tick,
@@ -720,7 +825,29 @@ def _fleet_main(args, params, cfg, prompts) -> int:
         "token_mismatches": mismatches,
         "decode_prefill_chunks": decode_prefill_chunks,
         "fleet_threshold": args.fleet_threshold,
+        "transport": args.transport,
     }
+    if wire is not None:
+        rtt = wire["migrate_rtt_ms"]
+        stale = wire["status_staleness_ms"]
+        out["wire"] = {
+            "migrate_rtt_ms": {
+                "p50": round(_percentile(rtt, 0.50), 3),
+                "p99": round(_percentile(rtt, 0.99), 3),
+                "n": len(rtt),
+            },
+            "status_staleness_ms": {
+                "p50": round(_percentile(stale, 0.50), 3),
+                "p99": round(_percentile(stale, 0.99), 3),
+                "n": len(stale),
+            },
+        }
+        if wire["stats"] is not None:
+            # the transport's own verdict counters (socket only), sans
+            # the raw per-peer latency lists trace --summarize owns
+            out["wire"].update({
+                k: v for k, v in wire["stats"].items() if k != "send_ms"
+            })
     out["fleet_speedup"] = (
         round(out["tokens_per_s"] / out["single_tokens_per_s"], 3)
         if out["single_tokens_per_s"] else None

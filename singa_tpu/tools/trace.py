@@ -342,6 +342,38 @@ def summarize(records: list[dict]) -> dict:
                 "drains": c.get("drain", 0),
             }
 
+    # wire transport (comm/wire.py): connect/retry/timeout/redeliver
+    # lifecycle counts plus per-peer send-latency percentiles from
+    # wire_send events — enough to reconstruct connect -> retry ->
+    # redeliver -> resume from a merged multi-host trace
+    wire_counts = {
+        k: counts.get(f"wire_{k}", 0)
+        for k in (
+            "connect", "send", "retry", "timeout", "redeliver",
+            "crc_reject", "partition_heal",
+        )
+    }
+    wire_peer_ms: dict[str, list[float]] = {}
+    for r in life:
+        if r.get("kind") != "wire_send" or not isinstance(
+            r.get("data"), dict
+        ):
+            continue
+        peer = str(r["data"].get("peer", "?"))
+        wire_peer_ms.setdefault(peer, []).append(
+            float(r["data"].get("ms", 0.0))
+        )
+    wire_peers = {}
+    for peer in sorted(wire_peer_ms):
+        ms = sorted(wire_peer_ms[peer])
+        wire_peers[peer] = {
+            "sends": len(ms),
+            "send_ms": {
+                "p50": round(_percentile(ms, 0.50), 3),
+                "p99": round(_percentile(ms, 0.99), 3),
+            },
+        }
+
     faults = [
         r["data"].get("fault")
         for r in life
@@ -481,6 +513,16 @@ def summarize(records: list[dict]) -> dict:
             request_ms or ticks or counts.get("request_admit")
             or fleet_roles or counts.get("route")
         )
+        else None,
+        # wire transport (None unless wire_* events are present — the
+        # mailbox/in-process wirings emit none): retry/redelivery
+        # verdict counts + per-peer send-latency percentiles
+        "wire": {
+            **wire_counts,
+            "peer_deaths": counts.get("peer_death", 0),
+            "peers": wire_peers or None,
+        }
+        if any(wire_counts.values())
         else None,
     }
 
