@@ -223,12 +223,25 @@ def _wire_fields(trainer, nominal_ndata: int = 8) -> dict:
         ndata=max(nominal_ndata, trainer._ring_ndata())
     )
     ref, ring = model["reference"], model["quantized_ring"]
-    return {
+    fields = {
         "wire_ndata": model["ndata"],
         "wire_ref_bytes": ref,
         "wire_ring_bytes": ring,
         "wire_bytes_ratio": round(ref / ring, 3) if ring else None,
     }
+    if "inter" in model:
+        # the hierarchical row's per-level split: the scarce inter-slice
+        # bytes x intra_degree must stay at or under the flat same-n
+        # ring (K(M-1) <= KM-1) — `wire_inter_vs_flat` <= 1.0 pins it
+        flat = model.get("flat_ring")
+        fields["wire_intra_bytes"] = model["intra"]
+        fields["wire_inter_bytes"] = model["inter"]
+        fields["wire_intra_degree"] = model["intra_degree"]
+        fields["wire_inter_vs_flat"] = (
+            round(model["inter"] * model["intra_degree"] / flat, 3)
+            if flat else None
+        )
+    return fields
 
 
 def _tmpdir() -> str:
@@ -440,6 +453,25 @@ def bench_lm_d128_q8wire(n1=256, n2=1280):
     return bench_tinylm(
         n1, n2, name="lm_d128_q8wire", conf="tinylm_d128.conf",
         grad_comm="q8wire", comm_buckets=4,
+    )
+
+
+def bench_lm_d128_q8hier(n1=256, n2=1280):
+    """`lm_d128_q8wire` with `kernels { grad_allreduce: q8_hier }` and
+    `ring { intra_degree: 2 }` — the two-level hierarchical ring:
+    intra-slice reduce-scatter/allgather on the f32 fast wire, ONE int8
+    ring over group leaders on the scarce inter-slice hop. On the
+    1-wide bench host the runtime geometry degenerates (no hops), so
+    the row's numbers come from the nominal-width pricing in
+    `wire_bytes_model`: `wire_intra_bytes`/`wire_inter_bytes` are the
+    per-level model at the configured intra_degree, and
+    `wire_inter_vs_flat` (inter x K over the flat same-n ring, <= 1.0
+    by the K(M-1) <= KM-1 identity) is the deterministic number the
+    row exists to pin — the hierarchy must never pay more on the slow
+    wire than the flat ring it replaces."""
+    return bench_tinylm(
+        n1, n2, name="lm_d128_q8hier", conf="tinylm_d128.conf",
+        grad_comm="q8hier", comm_buckets=4,
     )
 
 
@@ -707,6 +739,7 @@ BENCHES = (
     ("lm_d128_zero", bench_lm_d128_zero),
     ("lm_d128_q8", bench_lm_d128_q8),
     ("lm_d128_q8wire", bench_lm_d128_q8wire),
+    ("lm_d128_q8hier", bench_lm_d128_q8hier),
     ("lm_d128_serve", bench_lm_d128_serve),
     ("lm_d128_spec", bench_lm_d128_spec),
     ("lm_d128_prefix", bench_lm_d128_prefix),

@@ -916,7 +916,33 @@ class FleetConfig(Message):
 
 
 KERNEL_IMPLS = ("reference", "fused")
-GRAD_ALLREDUCE_IMPLS = ("reference", "quantized_ring")
+GRAD_ALLREDUCE_IMPLS = ("reference", "quantized_ring", "q8_hier")
+
+
+class RingConfig(Message):
+    """singa-tpu extension: two-level ring geometry for
+    ``kernels { grad_allreduce: q8_hier }`` (the EQuARX deployment
+    topology, arxiv 2506.17615 — fast intra-slice ICI feeding one
+    scarce inter-slice DCN hop). Two mutually exclusive forms:
+
+    - factored data axis: ``intra_degree: K`` splits the single
+      ``data`` axis of width n into n/K groups of K adjacent ranks —
+      the intra rings run over each K-block, the quantized inter ring
+      over same-position ranks across blocks.
+    - named axes: ``intra_axis`` / ``inter_axis`` name two real mesh
+      axes (e.g. ``data`` × ``model``) and the reduction runs over
+      their product, int8 only on the inter_axis hops.
+    """
+
+    FIELDS = {
+        # mesh axis for the fast (full-precision) intra-slice rings
+        "intra_axis": Field("string", ""),
+        # mesh axis for the scarce (quantized) inter-slice ring
+        "inter_axis": Field("string", ""),
+        # factored form: group width K carved out of the data axis
+        # (must divide it); 0 = use the named-axes form above
+        "intra_degree": Field("int", 0),
+    }
 
 
 class KernelsConfig(Message):
@@ -953,7 +979,17 @@ class KernelsConfig(Message):
     is skipped) and ``error_feedback``; the replica engine rejects it
     (netlint KRN002 flags both statically, plus un-chunkable data-axis
     geometry). ``reference`` keeps the dequantize-then-psum oracle —
-    jaxpr-identical to a config with no knob."""
+    jaxpr-identical to a config with no knob.
+
+    ``grad_allreduce: q8_hier`` is the hierarchical two-level form
+    (EQuARX's deployment topology): full-precision intra-slice ring
+    reduce-scatter over the fast axis, ONE int8 inter-slice ring over
+    group leaders (the quantization lands where bandwidth is
+    scarcest), then the intra-slice allgather. Geometry comes from the
+    model conf's ``ring {}`` block (``intra_degree`` to factor the
+    data axis, or ``intra_axis``/``inter_axis`` to name two mesh
+    axes); unlike the flat ring it accepts composed meshes whose
+    non-data axes the factorization covers."""
 
     FIELDS = {
         # serving-tier attention: "reference" gather + cache_attend
@@ -962,6 +998,8 @@ class KernelsConfig(Message):
         # training-tier gradient collective: "reference" = grad_comm's
         # quantize-around-the-psum oracle (fp32 on the wire),
         # "quantized_ring" = int8-on-the-wire ppermute ring
+        # "q8_hier" = hierarchical two-level ring (f32 intra-slice,
+        # int8 inter-slice; geometry from the model's ring {} block)
         "grad_allreduce": Field(
             "enum", "reference", enum=GRAD_ALLREDUCE_IMPLS
         ),
@@ -1070,6 +1108,10 @@ class ModelConfig(Message):
         # (singa_tpu/serve/fleet/) — presence dispatches main.py to a
         # fleet host (role by rank) instead of the trainer ---
         "fleet": Field("message", message=FleetConfig),
+        # --- singa-tpu extension: two-level ring geometry for
+        # kernels { grad_allreduce: q8_hier } (see RingConfig). Absent
+        # with q8_hier = ConfigError at trainer construction. ---
+        "ring": Field("message", message=RingConfig),
     }
 
 
@@ -1111,6 +1153,12 @@ class ClusterConfig(Message):
         # static mirror of the OOM the pod would hit. 0 (default) =
         # no declared budget, MEM001 stays silent.
         "device_hbm_bytes": Field("int", 0),
+        # ---- singa-tpu extension: inter-slice (DCN) bandwidth in
+        # bytes/sec for the cost-aware shardlint. When > 0 and the
+        # model runs the hierarchical ring (q8_hier), --explain-cost
+        # prices the scarce inter-slice hop's transfer time from the
+        # per-level wire model. 0 (default) = no declared bandwidth.
+        "inter_slice_bandwidth": Field("int", 0),
     }
 
     @property

@@ -38,7 +38,11 @@ Parity bar (tests/test_cost_model.py, CI-held): the modeled opt-state
 bytes equal the dryrun trainer's measured ``opt_state_bytes_per_device``
 and the modeled ring wire bytes equal BOTH ``modeled_wire_bytes_per_step``
 and the jaxpr-counted ppermute bytes — a cost model that drifts from the
-real program is a lint bug.
+real program is a lint bug. Under ``grad_allreduce: q8_hier`` the single
+ring row splits into intra-slice (f32) and inter-slice (quantized) rows,
+each parity-held against ``modeled_wire_bytes_levels``; a declared
+``cluster { inter_slice_bandwidth }`` adds a DCN transfer-time row to
+``--explain-cost``.
 
 Like shape_rules, the HBM/collective half needs a BUILT net (data layers
 open their sources); when the shards aren't present the model degrades
@@ -107,6 +111,8 @@ class CostReport:
     compute_bytes: int  # modeled MXU operand traffic per step (proxy)
     bubble: float  # GPipe fill/drain fraction, 0.0 when not pipelined
     notes: list[str]
+    #: cluster { inter_slice_bandwidth } (bytes/s DCN); 0 = undeclared
+    inter_slice_bandwidth: int = 0
 
     @property
     def hbm_bytes(self) -> int:
@@ -271,10 +277,28 @@ def _ring_active(model_cfg: ModelConfig) -> bool:
     gc = model_cfg.grad_comm
     return (
         kern is not None
-        and kern.grad_allreduce == "quantized_ring"
+        and kern.grad_allreduce in ("quantized_ring", "q8_hier")
         and gc is not None
         and gc.mode == "quantized"
     )
+
+
+def _hier_geometry(
+    model_cfg: ModelConfig, widths: dict[str, int]
+) -> tuple[int, int] | None:
+    """(K, M) when the hierarchical ring is requested AND its geometry
+    resolves on these widths; None for the flat ring or a broken ring{}
+    block (KRN002 owns the diagnostic for the latter — the trainer
+    rejects that config at construction, so there is no step to price)."""
+    kern = model_cfg.kernels
+    if kern is None or kern.grad_allreduce != "q8_hier":
+        return None
+    from ..ops.quantized_collective import hier_ring_geometry
+
+    geom = hier_ring_geometry(widths, model_cfg.ring)
+    if isinstance(geom, str):
+        return None
+    return geom[2], geom[3]
 
 
 def build_cost_model(
@@ -324,6 +348,11 @@ def build_cost_model(
         gc is not None and gc.mode == "quantized" and gc.error_feedback
     )
     ring = _ring_active(model_cfg)
+    hier = _hier_geometry(model_cfg, widths) if ring else None
+    if hier is not None:
+        # the two-level ring reduces over intra*inter devices; the
+        # named-axes form widens the data reduction past widths["data"]
+        ndata = max(ndata, hier[0] * hier[1])
 
     param_bytes = 0
     opt_bytes = 0
@@ -396,12 +425,38 @@ def build_cost_model(
             buckets = reverse_topo_buckets(
                 net, frozenset(sizes), gc.buckets, specs
             )
-            wire = modeled_wire_bytes(
-                sizes, buckets, ndata, dtype=gc.dtype, gather=gather
-            )
-            collectives.append(
-                (f"grad ring reduce ({gc.dtype} wire)", int(wire))
-            )
+            if hier is not None:
+                from ..ops.quantized_collective import (
+                    modeled_wire_bytes_levels,
+                )
+
+                levels = modeled_wire_bytes_levels(
+                    sizes,
+                    buckets,
+                    ndata,
+                    intra_degree=hier[0],
+                    dtype=gc.dtype,
+                    gather=gather,
+                )
+                collectives.append(
+                    (
+                        "grad ring intra-slice (f32 wire)",
+                        int(levels["intra"]),
+                    )
+                )
+                collectives.append(
+                    (
+                        f"grad ring inter-slice ({gc.dtype} wire)",
+                        int(levels["inter"]),
+                    )
+                )
+            else:
+                wire = modeled_wire_bytes(
+                    sizes, buckets, ndata, dtype=gc.dtype, gather=gather
+                )
+                collectives.append(
+                    (f"grad ring reduce ({gc.dtype} wire)", int(wire))
+                )
         else:
             wire = reference_wire_bytes(sizes, ndata, scatter_only=zero)
             label = (
@@ -660,6 +715,8 @@ def cost_rules(
     report = build_cost_model(model_cfg, widths, path)
     if report is None:
         return None
+    if cluster_cfg is not None:
+        report.inter_slice_bandwidth = cluster_cfg.inter_slice_bandwidth
     budget = cluster_cfg.device_hbm_bytes if cluster_cfg is not None else 0
     if budget > 0 and report.hbm_bytes > budget:
         parts = ", ".join(
@@ -743,6 +800,16 @@ def render_cost_report(report: CostReport) -> str:
             lines.append(f"    {label:<28} {b:>14}  {_fmt_bytes(b)}")
     else:
         lines.append("    (none: single-device step)")
+    inter = sum(
+        b for label, b in report.collectives if "inter-slice" in label
+    )
+    if report.inter_slice_bandwidth > 0 and inter:
+        secs = inter / report.inter_slice_bandwidth
+        lines.append(
+            f"  inter-slice transfer/step    {secs * 1e3:>13.3f}ms  "
+            f"({_fmt_bytes(inter)} at "
+            f"{_fmt_bytes(report.inter_slice_bandwidth)}/s DCN)"
+        )
     lines.append(
         f"  compute bytes/step (proxy)     {report.compute_bytes:>14}  "
         f"{_fmt_bytes(report.compute_bytes)}"

@@ -88,10 +88,12 @@ KRN001 = rule(
 KRN002 = rule(
     "KRN002",
     ERROR,
-    "quantized_ring grad_allreduce without a quantized grad_comm "
-    "block, with an un-chunkable data-axis geometry, with a >1-wide "
-    "non-data mesh axis, with a batch-stat (kBatchNorm) net, or with "
-    "the replica engine",
+    "ring grad_allreduce (quantized_ring/q8_hier) without a quantized "
+    "grad_comm block, with an un-chunkable data-axis geometry, with a "
+    ">1-wide non-data mesh axis the factorization does not cover, "
+    "with a broken ring {} two-level geometry (absent axis, "
+    "indivisible intra_degree), with a batch-stat (kBatchNorm) net, "
+    "or with the replica engine",
 )
 ELA001 = rule(
     "ELA001",
@@ -981,23 +983,33 @@ def ring_rules(
     data-axis shard_map shape (``CDTrainer`` rejects at construction);
     (4) a batch-stat (kBatchNorm) net — inside the ring's per-shard
     backward, sync BN's GSPMD-psum'd global moments would silently
-    become local-shard stats; (5) a >1-wide non-data mesh axis — the
-    ring is flat over the data axis; hierarchical two-level rings are a
-    ROADMAP carry-over; (6) a train batchsize the data-axis width
-    cannot divide — each shard computes its own local partial; (7) a
-    data-axis width the ring's bucket chunking cannot divide — checked
-    on the statically-declared neuron dims (a layer's bias gradient is
+    become local-shard stats; (5) a >1-wide non-data mesh axis under
+    the FLAT ring — q8_hier with a covering ring {} factorization is
+    the acceptance path; (5b, q8_hier only) a broken two-level
+    geometry — ``hier_ring_geometry``'s reason verbatim (missing
+    ``ring {}`` block, an intra/inter axis naming no mesh axis — with
+    a did-you-mean over the cluster's axes — an intra_degree the data
+    width cannot divide, or an uncovered >1-wide leftover axis),
+    threaded through ``--cluster``; (6) a train batchsize the
+    reduction width (K*M for q8_hier) cannot divide — each shard
+    computes its own local partial; (7) a reduction width the ring's
+    bucket chunking cannot divide — checked on the
+    statically-declared neuron dims (a layer's bias gradient is
     ``(num_output,)``, chunked on dim 0; weight input dims need shape
     inference and are left to the runtime predicate)."""
     kern = getattr(model_cfg, "kernels", None)
-    if kern is None or kern.grad_allreduce != "quantized_ring":
+    if kern is None or kern.grad_allreduce not in (
+        "quantized_ring", "q8_hier"
+    ):
         return
+    impl = kern.grad_allreduce
+    hier = impl == "q8_hier"
     gc = getattr(model_cfg, "grad_comm", None)
     if gc is None or gc.mode != "quantized":
         col.emit(
             KRN002,
             path,
-            "kernels.grad_allreduce 'quantized_ring' without an active "
+            f"kernels.grad_allreduce '{impl}' without an active "
             "grad_comm { mode: quantized } block: the ring is the "
             "quantized collective's wire implementation — the trainer "
             "rejects this config at construction",
@@ -1014,7 +1026,7 @@ def ring_rules(
         col.emit(
             KRN002,
             path,
-            "kernels.grad_allreduce 'quantized_ring' with an "
+            f"kernels.grad_allreduce '{impl}' with an "
             "asynchronous nservers>0 cluster: the replica engine's "
             "EASGD protocol owns its own gradient sync and rejects the "
             "ring at construction",
@@ -1025,7 +1037,7 @@ def ring_rules(
         col.emit(
             KRN002,
             path,
-            "kernels.grad_allreduce 'quantized_ring' with the "
+            f"kernels.grad_allreduce '{impl}' with the "
             "kContrastiveDivergence engine: the CD trainer's layerwise "
             "step does not take the ring's data-axis shard_map shape "
             "and rejects it at construction",
@@ -1040,31 +1052,88 @@ def ring_rules(
         col.emit(
             KRN002,
             path,
-            "kernels.grad_allreduce 'quantized_ring' with batch-stat "
+            f"kernels.grad_allreduce '{impl}' with batch-stat "
             f"layers {bn}: the ring's per-shard backward would turn "
             "sync BatchNorm into local-shard BN (biased variance) — "
             "the trainer rejects this config at construction",
             fix_hint="drop the kBatchNorm layers, or keep "
             "grad_allreduce: reference",
         )
-    other = {
-        a: w
-        for a, w in (widths or {}).items()
-        if a != "data" and w > 1
-    }
-    if other:
-        col.emit(
-            KRN002,
-            path,
-            "kernels.grad_allreduce 'quantized_ring' runs over the "
-            f"data axis only, but the cluster also shards {other} — "
-            "hierarchical (intra/inter-slice) two-level rings are a "
-            "ROADMAP carry-over; the trainer rejects this config at "
-            "construction",
-            fix_hint="widen only the data axis, or keep "
-            "grad_allreduce: reference",
-        )
+    ring_cfg = getattr(model_cfg, "ring", None)
     ndata = (widths or {}).get("data", 0)
+    if hier:
+        from ..ops.quantized_collective import hier_ring_geometry
+
+        if widths is not None:
+            geom = hier_ring_geometry(widths, ring_cfg)
+        else:
+            # no --cluster: validate the ring {} block's FORM only,
+            # against a mesh that cannot trigger width errors
+            intra = getattr(ring_cfg, "intra_axis", "") or ""
+            inter = getattr(ring_cfg, "inter_axis", "") or ""
+            deg = int(getattr(ring_cfg, "intra_degree", 0) or 0)
+            fake = {a: 1 for a in (intra, inter) if a}
+            fake.setdefault("data", max(1, deg))
+            geom = hier_ring_geometry(fake, ring_cfg)
+        if isinstance(geom, str):
+            hint = (
+                "declare ring { intra_degree } dividing the data "
+                "width, or intra_axis/inter_axis naming two real mesh "
+                "axes that cover every >1-wide axis"
+            )
+            if widths and ring_cfg is not None:
+                import difflib
+
+                sugg = []
+                for role in ("intra_axis", "inter_axis"):
+                    ax = getattr(ring_cfg, role, "")
+                    if ax and ax not in widths:
+                        close = difflib.get_close_matches(
+                            ax, sorted(widths), n=1
+                        )
+                        if close:
+                            sugg.append(f"{role}: {close[0]}")
+                if sugg:
+                    hint = "did you mean " + ", ".join(sugg) + "?"
+            col.emit(
+                KRN002,
+                path,
+                f"kernels.grad_allreduce 'q8_hier' cannot run: {geom} "
+                "— the trainer rejects this config at construction",
+                fix_hint=hint,
+            )
+        else:
+            ndata = geom[2] * geom[3]
+            if geom[0] != geom[1] and bool(model_cfg.zero_update):
+                col.emit(
+                    KRN002,
+                    path,
+                    "kernels.grad_allreduce 'q8_hier' with named "
+                    "intra_axis/inter_axis does not compose with "
+                    "zero_update (the update layout shards over the "
+                    "data axis only) — the trainer rejects this "
+                    "config at construction",
+                    fix_hint="use the factored ring { intra_degree } "
+                    "form, or drop zero_update",
+                )
+    else:
+        other = {
+            a: w
+            for a, w in (widths or {}).items()
+            if a != "data" and w > 1
+        }
+        if other:
+            col.emit(
+                KRN002,
+                path,
+                "kernels.grad_allreduce 'quantized_ring' runs over the "
+                f"data axis only, but the cluster also shards {other} "
+                "— the trainer rejects this config at construction",
+                fix_hint="switch to grad_allreduce: q8_hier with a "
+                "ring { intra_axis/inter_axis } block covering the "
+                "extra axis, widen only the data axis, or keep "
+                "grad_allreduce: reference",
+            )
     net_cfg = model_cfg.neuralnet
     if ndata <= 1 or net_cfg is None:
         return
@@ -1075,11 +1144,12 @@ def ring_rules(
             col.emit(
                 KRN002,
                 path,
-                f"kernels.grad_allreduce 'quantized_ring' on a {ndata}"
-                f"-wide data axis, but layer {l.name!r}'s train "
-                f"batchsize {bs} is not divisible by it: each shard "
-                "computes its own local partial gradients — the "
-                "trainer rejects this config at construction",
+                f"kernels.grad_allreduce '{impl}' on a {ndata}"
+                "-wide data reduction, but layer "
+                f"{l.name!r}'s train batchsize {bs} is not divisible "
+                "by it: each shard computes its own local partial "
+                "gradients — the trainer rejects this config at "
+                "construction",
                 fix_hint=f"pick a batchsize divisible by {ndata}, or "
                 "resize the data axis",
             )
@@ -1098,9 +1168,9 @@ def ring_rules(
         col.emit(
             KRN002,
             path,
-            f"kernels.grad_allreduce 'quantized_ring' on a {ndata}-wide "
-            f"data axis, but {reason} — the trainer rejects this config "
-            "at construction",
+            f"kernels.grad_allreduce '{impl}' on a {ndata}-wide "
+            f"data reduction, but {reason} — the trainer rejects this "
+            "config at construction",
             fix_hint=f"pick neuron dims divisible by {ndata}, resize "
             "the data axis, or keep grad_allreduce: reference",
         )

@@ -57,6 +57,41 @@ path that every test run exercises. ``fused_hop`` swaps the per-hop
 dequantize+accumulate onto a small Pallas kernel for real hardware
 (``quant_acc``), gated by the same ``fusable``-style geometry predicate
 pattern as the paged-attention kernel (``ring_fusable``).
+
+Hierarchical two-level form (``kernels { grad_allreduce: q8_hier }``):
+EQuARX's deployment topology is not one flat ring — it is fast
+intra-slice ICI feeding ONE scarce inter-slice DCN hop, and the int8
+saving matters exactly on the scarce hop. ``hier_ring_geometry``
+factors the n-wide data reduction as K (intra) x M (inter): rank
+r = g*K + p runs
+
+  intra reduce-scatter   K-1 hops over the fast axis in FULL f32 (ICI
+                         bandwidth is cheap; no quantization error is
+                         introduced where it buys nothing), piece-major
+                         — after K-1 hops rank (g, p) holds the
+                         group-local sum of every chunk at position p,
+                         an (M, chunk) plane.
+  inter quantized ring   M-1 hops over the scarce axis with the SAME
+                         int8 + per-bucket-scale + dequant/accumulate/
+                         requant discipline as the flat ring — rank
+                         (g, p) finishes owning the global sum of chunk
+                         g*K + p, the identical post-scatter state as
+                         the flat ring, so error feedback and the
+                         owner-side final quantize are literally shared
+                         code.
+  two-level allgather    the (int8 bytes, scale) pairs ride M-1 inter
+                         hops then K-1 intra hops (whole plane at a
+                         time), every rank dequantizes identical bytes
+                         — the gathered gradient stays bitwise
+                         ring-invariant. zero_update still skips it.
+
+Chunk granularity stays n = K*M, so residual layouts, zero_update
+shards, and sharded checkpoints are indistinguishable from a flat ring
+of the same total width. Per-level wire accounting lives in
+``modeled_wire_bytes_levels`` (analytic) and
+``ppermute_wire_bytes_levels`` (jaxpr-counted), parity-held in tests;
+the inter-slice bytes shrink by ~the intra degree vs the flat ring
+(exactly: K*(M-1) <= n-1 chunks cross the scarce axis instead of n-1).
 """
 
 from __future__ import annotations
@@ -200,6 +235,104 @@ def ring_fusable(
     return None
 
 
+def hier_ring_geometry(widths, ring, *, data_axis: str = "data"):
+    """Resolve the two-level ring geometry for ``q8_hier``: returns
+    ``(intra_axis, inter_axis, K, M)`` when the mesh admits the
+    factorization, else the reason string. The trainer raises the
+    reason at construction and netlint's KRN002 reports it statically
+    — one predicate, so the static mirror cannot drift. This is the
+    generalization seam for ``ring_reducible``/``ring_fusable``: the
+    flat ring's loud composed-mesh rejection becomes the FALLBACK
+    (``quantized_ring`` keeps it), while ``q8_hier`` accepts any mesh
+    this factorization covers, then runs the chunkability predicates
+    with the TOTAL width n = K*M.
+
+    ``widths`` maps mesh axis -> width; ``ring`` is the model conf's
+    ``ring {}`` block (or None). Factored form: ``intra_degree: K``
+    splits the ``data`` axis into M = n/K groups of K adjacent ranks
+    (K must divide the data width; every other axis must be 1-wide —
+    nothing else covers them). Named form: ``intra_axis`` /
+    ``inter_axis`` name two distinct mesh axes whose product IS the
+    data reduction (the batch shards over both); the ``data`` axis
+    must be one of them when >1-wide, and no third axis may be >1-wide.
+    A 1-wide reduction degenerates to K = M = 1 (the ring is a no-op,
+    same as ``ring_reducible``'s ``ndata <= 1`` convention)."""
+    widths = {k: int(v) for k, v in (widths or {}).items()}
+    intra = getattr(ring, "intra_axis", "") if ring is not None else ""
+    inter = getattr(ring, "inter_axis", "") if ring is not None else ""
+    degree = int(getattr(ring, "intra_degree", 0) or 0)
+    if not degree and not intra and not inter:
+        return (
+            "kernels { grad_allreduce: q8_hier } needs a ring {} block "
+            "declaring the two-level geometry: intra_degree to factor "
+            "the data axis, or intra_axis/inter_axis naming mesh axes"
+        )
+    if degree and (intra or inter):
+        return (
+            "ring { intra_degree } and ring { intra_axis/inter_axis } "
+            "are mutually exclusive: the factored form splits the data "
+            "axis itself, the named form rides two real mesh axes"
+        )
+    if degree:
+        n = widths.get(data_axis, 1)
+        others = sorted(
+            a for a, wd in widths.items() if a != data_axis and wd > 1
+        )
+        if others:
+            return (
+                f"ring {{ intra_degree: {degree} }} factors the "
+                f"{data_axis!r} axis only, but the mesh also shards "
+                + ", ".join(f"{a!r} (width {widths[a]})" for a in others)
+                + " — name the extra axis with ring { intra_axis/"
+                "inter_axis } if the reduction should ride it"
+            )
+        if n <= 1:
+            return (data_axis, data_axis, 1, 1)
+        if degree > n or n % degree:
+            return (
+                f"ring {{ intra_degree: {degree} }} does not divide the "
+                f"{data_axis!r} axis width {n}: the two-level "
+                "factorization needs n = intra_degree * inter groups"
+            )
+        return (data_axis, data_axis, degree, n // degree)
+    if not intra or not inter:
+        return (
+            "ring { intra_axis/inter_axis } must name BOTH axes (got "
+            f"intra_axis={intra!r}, inter_axis={inter!r}) — or use "
+            "intra_degree to factor the data axis"
+        )
+    if intra == inter:
+        return (
+            f"ring {{ intra_axis: {intra!r} }} and inter_axis name the "
+            "same mesh axis — use intra_degree to factor one axis"
+        )
+    for role, ax in (("intra_axis", intra), ("inter_axis", inter)):
+        if ax not in widths:
+            return (
+                f"ring {{ {role}: {ax!r} }} names no mesh axis "
+                f"(mesh axes: {', '.join(sorted(widths)) or 'none'})"
+            )
+    if widths.get(data_axis, 1) > 1 and data_axis not in (intra, inter):
+        return (
+            f"the {data_axis!r} axis (width {widths[data_axis]}) is "
+            "not covered by ring { intra_axis/inter_axis } — the "
+            "gradient reduction must include every data shard"
+        )
+    leftovers = sorted(
+        a for a, wd in widths.items()
+        if wd > 1 and a not in (intra, inter)
+    )
+    if leftovers:
+        return (
+            "mesh axes "
+            + ", ".join(f"{a!r} (width {widths[a]})" for a in leftovers)
+            + " are >1-wide but outside the ring { intra_axis/"
+            "inter_axis } factorization — the two-level ring covers "
+            "exactly two axes"
+        )
+    return (intra, inter, widths[intra], widths[inter])
+
+
 # ---------------------------------------------------------------------------
 # optional Pallas inner kernel: dequantize + accumulate fused per hop
 # ---------------------------------------------------------------------------
@@ -259,6 +392,193 @@ def _shard_shape(shape, d: int, n: int):
     )
 
 
+def _hier_reduce_scatter(
+    chunks: dict, p, g, K: int, M: int, pperm_intra, pperm_inter,
+    dtype: str, fused_hop: bool, fused_interpret: bool,
+) -> dict:
+    """Two-level reduce-scatter over already-chunked grads: -> each
+    rank's fully-summed own chunk (index g*K + p), shape (c, ...) —
+    the same post-scatter state as the flat ring's scan.
+
+    Level 1 (intra, f32 wire): view the n = K*M chunks piece-major as
+    (K, M, c, ...) — piece j holds every chunk at intra position j —
+    and ring-reduce-scatter the K pieces over the fast axis in full
+    f32: after K-1 hops rank (g, p) holds the group-g-local sum of
+    piece p, an (M, c, ...) plane. Quantizing here would buy nothing
+    (ICI is the cheap hop) and would cost rounding error per hop.
+
+    Level 2 (inter, quantized wire): ring-reduce-scatter the M plane
+    entries over the scarce axis with the flat ring's exact per-hop
+    discipline — one symmetric scale per bucket, int8 bytes + scale
+    ppermute'd, dequant + f32 accumulate (+ requant next hop)."""
+    # piece-major view: pieces[nm][j, gg] = chunk gg*K + j, f32 so the
+    # intra accumulation (and its wire) is full precision by contract
+    pieces = {
+        nm: jnp.swapaxes(
+            c.reshape((M, K) + c.shape[1:]), 0, 1
+        ).astype(jnp.float32)
+        for nm, c in chunks.items()
+    }
+
+    def pick_piece(idx):
+        return {
+            nm: jax.lax.dynamic_index_in_dim(
+                pc, idx % K, axis=0, keepdims=False
+            )
+            for nm, pc in pieces.items()
+        }
+
+    acc = pick_piece(p - 1)  # (M, c, ...) per param
+
+    def ihop(carry, t):
+        moved = {nm: pperm_intra(a) for nm, a in carry.items()}
+        local = pick_piece(p - t - 2)
+        return {nm: moved[nm] + local[nm] for nm in carry}, None
+
+    if K > 1:
+        acc, _ = jax.lax.scan(ihop, acc, jnp.arange(K - 1))
+
+    def pick_group(idx):
+        return {
+            nm: jax.lax.dynamic_index_in_dim(
+                a, idx % M, axis=0, keepdims=False
+            )
+            for nm, a in acc.items()
+        }
+
+    out = pick_group(g - 1)  # (c, ...) per param
+
+    def xhop(carry, t):
+        scale = (
+            symmetric_scale(carry.values()) if dtype == "int8" else None
+        )
+        wires = {
+            nm: wire_cast(a, scale, dtype)[0] for nm, a in carry.items()
+        }
+        wires = {nm: pperm_inter(w) for nm, w in wires.items()}
+        if scale is not None:
+            scale = pperm_inter(scale)
+        local = pick_group(g - t - 2)
+        nxt = {}
+        for nm, w in wires.items():
+            if fused_hop and dtype == "int8":
+                nxt[nm] = quant_acc(
+                    w, scale, local[nm], interpret=fused_interpret
+                )
+            else:
+                nxt[nm] = wire_uncast(w, scale, dtype) + local[nm]
+        return nxt, None
+
+    if M > 1:
+        out, _ = jax.lax.scan(xhop, out, jnp.arange(M - 1))
+    return out
+
+
+def _hier_allgather(
+    fq: dict, fscale, p, g, K: int, M: int, pperm_intra, pperm_inter,
+    dtype: str,
+) -> dict:
+    """Two-level allgather of the owner-quantized (wire bytes, scale)
+    pairs: the inter ring collects the M chunk planes at this rank's
+    intra position, then the intra ring carries the collected
+    (M, c, ...) plane + (M,) scales around the group whole. Every rank
+    dequantizes IDENTICAL bytes with identical scales, so the gathered
+    gradient stays bitwise ring-invariant — same contract as the flat
+    allgather, int8 on the scarce hops only by construction (the intra
+    hops move the already-int8 planes too: bytes, not f32).
+    Returns {nm: (n, c, ...) f32} in chunk-index order."""
+    wnames = list(fq)
+    planes = {
+        nm: jax.lax.dynamic_update_index_in_dim(
+            jnp.zeros((M,) + fq[nm].shape, fq[nm].dtype),
+            fq[nm], g, axis=0,
+        )
+        for nm in wnames
+    }
+    scales = (
+        jax.lax.dynamic_update_index_in_dim(
+            jnp.zeros((M,), jnp.float32), fscale, g, axis=0
+        )
+        if fscale is not None
+        else None
+    )
+
+    def gxhop(carry, t):
+        planes, scales, w, s = carry
+        w = {nm: pperm_inter(v) for nm, v in w.items()}
+        if s is not None:
+            s = pperm_inter(s)
+        idx = (g - t - 1) % M
+        planes = {
+            nm: jax.lax.dynamic_update_index_in_dim(
+                planes[nm], w[nm], idx, axis=0
+            )
+            for nm in wnames
+        }
+        if s is not None:
+            scales = jax.lax.dynamic_update_index_in_dim(
+                scales, s, idx, axis=0
+            )
+        return (planes, scales, w, s), None
+
+    if M > 1:
+        (planes, scales, _, _), _ = jax.lax.scan(
+            gxhop,
+            (planes, scales, dict(fq), fscale),
+            jnp.arange(M - 1),
+        )
+    big = {
+        nm: jax.lax.dynamic_update_index_in_dim(
+            jnp.zeros((K,) + planes[nm].shape, planes[nm].dtype),
+            planes[nm], p, axis=0,
+        )
+        for nm in wnames
+    }
+    bigs = (
+        jax.lax.dynamic_update_index_in_dim(
+            jnp.zeros((K, M), jnp.float32), scales, p, axis=0
+        )
+        if scales is not None
+        else None
+    )
+
+    def gihop(carry, t):
+        big, bigs, w, s = carry
+        w = {nm: pperm_intra(v) for nm, v in w.items()}
+        if s is not None:
+            s = pperm_intra(s)
+        idx = (p - t - 1) % K
+        big = {
+            nm: jax.lax.dynamic_update_index_in_dim(
+                big[nm], w[nm], idx, axis=0
+            )
+            for nm in wnames
+        }
+        if s is not None:
+            bigs = jax.lax.dynamic_update_index_in_dim(
+                bigs, s, idx, axis=0
+            )
+        return (big, bigs, w, s), None
+
+    if K > 1:
+        (big, bigs, _, _), _ = jax.lax.scan(
+            gihop, (big, bigs, planes, scales), jnp.arange(K - 1)
+        )
+    out = {}
+    for nm in wnames:
+        arr = big[nm]  # (K, M, c, ...) wire dtype
+        if bigs is not None:
+            f = arr.astype(jnp.float32) * bigs.reshape(
+                (K, M) + (1,) * (arr.ndim - 2)
+            )
+        else:
+            f = arr.astype(jnp.float32)
+        # [j, gg] holds chunk gg*K + j -> chunk-index-major (n, c, ...)
+        f = jnp.swapaxes(f, 0, 1)
+        out[nm] = f.reshape((M * K,) + arr.shape[2:])
+    return out
+
+
 def ring_reduce_gradients(
     grads: dict,
     residuals: dict,
@@ -274,6 +594,7 @@ def ring_reduce_gradients(
     residual_key=None,
     fused_hop: bool = False,
     fused_interpret: bool = True,
+    hier: tuple | None = None,
 ) -> tuple[dict, dict]:
     """The quantized ring all-reduce, per shard: -> (reduced grads,
     new error-feedback residual chunks).
@@ -295,10 +616,52 @@ def ring_reduce_gradients(
     (int8 bytes, f32 scale) pairs on every shard, so the reduced
     gradient is bitwise identical ring-wide — tested, and what lets the
     step's out_specs declare them replicated.
+
+    ``hier = (intra_axis, inter_axis, K, M)`` (from
+    ``hier_ring_geometry``, with ``nshards == K*M``) swaps both phases
+    onto the hierarchical two-level form: f32 intra reduce-scatter,
+    quantized inter ring, two-level byte-carrying allgather. The
+    factored single-axis form has ``intra_axis == inter_axis`` and
+    builds structured perms on that one axis (rank r = g*K + p);
+    chunk granularity, the error-feedback/owner-quantize step between
+    the phases, and every output layout are SHARED with the flat ring.
     """
-    me = jax.lax.axis_index(axis_name)
     n = nshards
     perm = [(j, (j + 1) % n) for j in range(n)]
+    if hier is not None:
+        intra_ax, inter_ax, K, M = hier
+        if K * M != n:
+            raise ValueError(
+                f"hier geometry {K}x{M} does not match nshards {n}"
+            )
+        if intra_ax == inter_ax:  # factored data axis: rank = g*K + p
+            me = jax.lax.axis_index(intra_ax)
+            p, g = me % K, me // K
+            iperm = [
+                (gg * K + j, gg * K + (j + 1) % K)
+                for gg in range(M)
+                for j in range(K)
+            ]
+            xperm = [
+                (gg * K + j, ((gg + 1) % M) * K + j)
+                for gg in range(M)
+                for j in range(K)
+            ]
+        else:  # named mesh axes: chunk index = g*K + p by in_specs order
+            p = jax.lax.axis_index(intra_ax)
+            g = jax.lax.axis_index(inter_ax)
+            me = g * K + p
+            iperm = [(j, (j + 1) % K) for j in range(K)]
+            xperm = [(j, (j + 1) % M) for j in range(M)]
+
+        def pperm_intra(x):
+            return jax.lax.ppermute(x, intra_ax, iperm)
+
+        def pperm_inter(x):
+            return jax.lax.ppermute(x, inter_ax, xperm)
+
+    else:
+        me = jax.lax.axis_index(axis_name)
     out: dict = {}
     new_res: dict = {}
     token = None
@@ -329,8 +692,16 @@ def ring_reduce_gradients(
 
         # --- reduce-scatter: after n-1 hops shard ``me`` holds the
         # full sum of its own chunk ``me`` (start chunk me-1; the chunk
-        # arriving at hop t is me-t-2, accumulated in f32) ---
-        acc = pick(me - 1)
+        # arriving at hop t is me-t-2, accumulated in f32). The
+        # hierarchical form reaches the identical state through the
+        # two-level schedule (f32 intra, quantized inter) ---
+        if hier is not None:
+            acc = _hier_reduce_scatter(
+                chunks, p, g, K, M, pperm_intra, pperm_inter,
+                dtype, fused_hop, fused_interpret,
+            )
+        else:
+            acc = pick(me - 1)
 
         def hop(carry, t):
             acc = carry
@@ -357,7 +728,7 @@ def ring_reduce_gradients(
                     nxt[nm] = wire_uncast(w, scale, dtype) + local[nm]
             return nxt, None
 
-        if n > 1:
+        if n > 1 and hier is None:
             acc, _ = jax.lax.scan(hop, acc, jnp.arange(n - 1))
 
         # --- error-feedback injection + the one owner-side quantize:
@@ -394,7 +765,16 @@ def ring_reduce_gradients(
         # zero_update params skip this: their scatter chunk IS the
         # update-layout shard ---
         gathered = [nm for nm in bucket if gather[nm]]
-        if gathered and n > 1:
+        if gathered and n > 1 and hier is not None:
+            full = _hier_allgather(
+                {nm: fq[nm] for nm in gathered}, fscale,
+                p, g, K, M, pperm_intra, pperm_inter, dtype,
+            )
+            for nm in gathered:
+                out[nm] = _unchunk(
+                    full[nm], chunk_dims[nm], gs[nm].shape
+                ).astype(gs[nm].dtype)
+        elif gathered and n > 1:
             buf = {
                 nm: jax.lax.dynamic_update_index_in_dim(
                     jnp.zeros_like(chunks[nm], dtype=jnp.float32),
@@ -484,6 +864,122 @@ def modeled_wire_bytes(
         if gchunk:
             total += (ndata - 1) * (gchunk * w + scale_bytes)  # allgather
     return total
+
+
+def modeled_wire_bytes_levels(
+    sizes: dict, buckets: tuple, ndata: int, *,
+    intra_degree: int, dtype: str = "int8", gather: dict | None = None,
+) -> dict:
+    """Per-device, per-LEVEL bytes the hierarchical ring moves in one
+    step: ``{"intra": ..., "inter": ..., "total": ...}``. Per bucket
+    with chunk = sum(sizes)/n, K = intra_degree, M = n/K:
+
+      intra reduce   (K-1) hops x an (M, chunk) f32 plane (no scale —
+                     the fast hop is unquantized by design)
+      inter reduce   (M-1) hops x (chunk wire bytes + one f32 scale)
+      inter gather   (M-1) hops x (chunk wire bytes + scale), gathered
+                     params only (zero_update skips them)
+      intra gather   (K-1) hops x (M x chunk wire bytes + M scales) —
+                     the collected byte plane rides whole
+
+    ``total`` equals what ``ppermute_wire_bytes`` counts from the
+    traced step; the split is what ``ppermute_wire_bytes_levels``
+    attributes per level — both parities are CI-held. The scarce-hop
+    win vs the flat ring is exact integer math: K*(M-1) <= K*M - 1 =
+    n - 1 chunks cross the inter axis, so
+    inter_bytes * intra_degree <= flat modeled_wire_bytes always."""
+    if ndata <= 1:
+        return {"intra": 0, "inter": 0, "total": 0}
+    K = max(1, int(intra_degree))
+    if ndata % K:
+        raise ValueError(
+            f"intra_degree {K} does not divide ndata {ndata}"
+        )
+    M = ndata // K
+    w = _wire_itemsize(dtype)
+    scale_bytes = 4 if dtype == "int8" else 0
+    intra = inter = 0
+    for bucket in buckets:
+        chunk = sum(sizes[nm] // ndata for nm in bucket)
+        intra += (K - 1) * M * chunk * 4
+        inter += (M - 1) * (chunk * w + scale_bytes)
+        gchunk = sum(
+            sizes[nm] // ndata
+            for nm in bucket
+            if gather is None or gather[nm]
+        )
+        if gchunk:
+            inter += (M - 1) * (gchunk * w + scale_bytes)
+            intra += (K - 1) * (M * gchunk * w + M * scale_bytes)
+    return {
+        "intra": int(intra),
+        "inter": int(inter),
+        "total": int(intra + inter),
+    }
+
+
+def ppermute_wire_bytes_levels(
+    jaxpr, *, intra_axis: str = "data", inter_axis: str = "data",
+    intra_degree: int = 1,
+) -> dict:
+    """Per-level ppermute byte attribution for the hierarchical ring,
+    counted from the traced program: ``{"intra": ..., "inter": ...}``.
+    Distinct mesh axes classify each ppermute by its ``axis_name``;
+    the factored single-axis form classifies by perm STRUCTURE — a
+    within-group hop keeps ``src//K == dst//K``, the cross-group hop
+    keeps ``src%K == dst%K`` (disjoint for K, M > 1; a perm matching
+    neither — e.g. a flat ring's — raises, misuse is loud)."""
+    import jax.core as jcore
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    K = max(1, int(intra_degree))
+    out = {"intra": 0, "inter": 0}
+
+    def level(eqn) -> str:
+        ax = eqn.params.get("axis_name")
+        if isinstance(ax, (tuple, list)) and len(ax) == 1:
+            ax = ax[0]
+        if intra_axis != inter_axis:
+            if ax == intra_axis:
+                return "intra"
+            if ax == inter_axis:
+                return "inter"
+            raise ValueError(
+                f"ppermute over unexpected axis {ax!r} (expected "
+                f"{intra_axis!r} or {inter_axis!r})"
+            )
+        pairs = [(int(s), int(d)) for s, d in eqn.params["perm"]]
+        if all(s // K == d // K for s, d in pairs):
+            return "intra"
+        if all(s % K == d % K for s, d in pairs):
+            return "inter"
+        raise ValueError(
+            f"ppermute perm {pairs!r} matches neither ring level "
+            f"(intra_degree={K})"
+        )
+
+    def walk(jx, mult: int) -> None:
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "ppermute":
+                lv = level(eqn)
+                for v in eqn.invars:
+                    aval = v.aval
+                    out[lv] += (
+                        mult * int(aval.size) * jnp.dtype(aval.dtype).itemsize
+                    )
+            submult = mult
+            if eqn.primitive.name == "scan":
+                submult = mult * int(eqn.params.get("length", 1))
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (list, tuple)) else (val,)
+                for v in vals:
+                    if isinstance(v, jcore.ClosedJaxpr):
+                        walk(v.jaxpr, submult)
+                    elif isinstance(v, jcore.Jaxpr):
+                        walk(v, submult)
+
+    walk(inner, 1)
+    return out
 
 
 def reference_wire_bytes(

@@ -86,12 +86,20 @@ class GradCommSpec:
     buckets: int = 0  # 0/1 = per-param granularity, no ordering chain
     #: how the quantized reduction crosses the data axis: "reference"
     #: (grad_comm's cast around the GSPMD psum — fp32 on the wire, the
-    #: bitwise-pinned oracle) or "quantized_ring" (the explicit
-    #: int8-on-the-wire ppermute ring, ops/quantized_collective.py)
+    #: bitwise-pinned oracle), "quantized_ring" (the explicit
+    #: int8-on-the-wire ppermute ring, ops/quantized_collective.py),
+    #: or "q8_hier" (the hierarchical two-level ring: f32 intra-slice,
+    #: int8 inter-slice — geometry from the ``ring {}`` fields below)
     wire_impl: str = "reference"
     #: pure-XLA ppermute form (True, the CPU-CI path) vs the fused
     #: Pallas per-hop quantize+accumulate kernel (False, real hardware)
     interpret: bool = True
+    #: ``ring {}`` geometry for q8_hier (hier_ring_geometry resolves
+    #: these against the mesh): named axes, or the factored data-axis
+    #: group width. All empty/0 for the flat impls.
+    intra_axis: str = ""
+    inter_axis: str = ""
+    intra_degree: int = 0
 
     @property
     def quantized(self) -> bool:
@@ -103,10 +111,15 @@ class GradCommSpec:
 
     @property
     def ring(self) -> bool:
-        """Whether the data-axis reduction is the explicit quantized
-        ring (int8 bytes in the ppermutes) rather than the reference
-        dequantize-then-psum seam."""
-        return self.wire_impl == "quantized_ring"
+        """Whether the data-axis reduction is an explicit quantized
+        ring (int8 bytes in the ppermutes) — flat or hierarchical —
+        rather than the reference dequantize-then-psum seam."""
+        return self.wire_impl in ("quantized_ring", "q8_hier")
+
+    @property
+    def hier(self) -> bool:
+        """Whether the ring is the hierarchical two-level form."""
+        return self.wire_impl == "q8_hier"
 
     @property
     def wants_residuals(self) -> bool:
@@ -114,7 +127,7 @@ class GradCommSpec:
         return self.quantized and self.error_feedback
 
     @staticmethod
-    def from_config(cfg, kernels=None) -> "GradCommSpec | None":
+    def from_config(cfg, kernels=None, ring=None) -> "GradCommSpec | None":
         """-> GradCommSpec, or None when the block is absent OR
         structurally inert (mode exact, no bucketization). Returning
         None for an inert block is the bitwise-exactness guarantee:
@@ -122,22 +135,25 @@ class GradCommSpec:
         a config with no block traces — and ``kernels { grad_allreduce:
         reference }`` (the default) changes nothing about it.
 
-        ``kernels`` is the model conf's ``kernels {}`` block;
-        ``grad_allreduce: quantized_ring`` requires an active quantized
-        ``grad_comm`` block (the ring IS the quantized collective's
-        wire implementation — with nothing quantized there is no wire
-        value to narrow) and raises ConfigError without one."""
+        ``kernels`` is the model conf's ``kernels {}`` block; both ring
+        impls (``quantized_ring`` flat, ``q8_hier`` hierarchical)
+        require an active quantized ``grad_comm`` block (the ring IS
+        the quantized collective's wire implementation — with nothing
+        quantized there is no wire value to narrow) and raise
+        ConfigError without one. ``ring`` is the model conf's
+        ``ring {}`` geometry block, carried verbatim for q8_hier (the
+        mesh-aware validation lives in ``hier_ring_geometry``)."""
         impl = (
             kernels.grad_allreduce if kernels is not None else "reference"
         )
         interpret = bool(kernels.interpret) if kernels is not None else True
-        if impl == "quantized_ring" and (
+        if impl in ("quantized_ring", "q8_hier") and (
             cfg is None or cfg.mode != "quantized"
         ):
             from ..config.schema import ConfigError
 
             raise ConfigError(
-                "kernels { grad_allreduce: quantized_ring } needs an "
+                f"kernels {{ grad_allreduce: {impl} }} needs an "
                 "active grad_comm { mode: quantized } block: the ring is "
                 "the quantized collective's wire implementation"
             )
@@ -150,6 +166,15 @@ class GradCommSpec:
             buckets=max(0, int(cfg.buckets)),
             wire_impl=impl,
             interpret=interpret,
+            intra_axis=(
+                ring.intra_axis if ring is not None else ""
+            ),
+            inter_axis=(
+                ring.inter_axis if ring is not None else ""
+            ),
+            intra_degree=(
+                max(0, int(ring.intra_degree)) if ring is not None else 0
+            ),
         )
         if not spec.quantized and not spec.overlapped:
             return None
@@ -160,29 +185,38 @@ def apply_grad_comm_tag(cfg, tag: str):
     """CLI shorthand -> ``cfg.grad_comm`` (sweep / convergence / bench):
     ``q8`` = quantized int8 + error feedback, ``bf16`` = quantized bf16,
     ``q8wire`` = q8 with the int8-on-the-wire ring collective
-    (``kernels { grad_allreduce: quantized_ring }``), ``exact`` = an
-    explicit (inert) exact block, "" = leave untouched."""
+    (``kernels { grad_allreduce: quantized_ring }``), ``q8hier`` = q8
+    with the hierarchical two-level ring (``q8_hier`` + a factored
+    ``ring { intra_degree: 2 }`` when the conf declares no geometry),
+    ``exact`` = an explicit (inert) exact block, "" = leave
+    untouched."""
     if not tag:
         return cfg
-    from ..config.schema import GradCommConfig, KernelsConfig
+    from ..config.schema import GradCommConfig, KernelsConfig, RingConfig
 
     gc = GradCommConfig()
     if tag == "exact":
         gc.mode = "exact"
-    elif tag in ("q8", "q8wire"):
+    elif tag in ("q8", "q8wire", "q8hier"):
         gc.mode, gc.dtype = "quantized", "int8"
     elif tag == "bf16":
         gc.mode, gc.dtype = "quantized", "bf16"
     else:
         raise ValueError(
             f"unknown grad_comm tag {tag!r} (choose exact, q8, q8wire, "
-            "bf16)"
+            "q8hier, bf16)"
         )
     cfg.grad_comm = gc
-    if tag == "q8wire":
+    if tag in ("q8wire", "q8hier"):
         kern = cfg.kernels if cfg.kernels is not None else KernelsConfig()
-        kern.grad_allreduce = "quantized_ring"
+        kern.grad_allreduce = (
+            "q8_hier" if tag == "q8hier" else "quantized_ring"
+        )
         cfg.kernels = kern
+    if tag == "q8hier" and cfg.ring is None:
+        ring = RingConfig()
+        ring.intra_degree = 2
+        cfg.ring = ring
     return cfg
 
 

@@ -9,7 +9,7 @@ elementwise work, the wire value is a quarter / half the bytes, and the
 bucket chain lets the scheduler overlap reductions with backward
 compute. This tool — the sibling of ckpt/input/update_stall — measures
 it by timing the same small MLP job on an ``ndata``-wide virtual data
-mesh five ways:
+mesh six ways:
 
   exact       no grad_comm block (today's fp32 collective)
   quantized   mode quantized, per-param scales (no bucket chain)
@@ -17,6 +17,9 @@ mesh five ways:
   q8_overlap  quantized + bucketized (the full machinery)
   q8_ring     q8_overlap + ``kernels { grad_allreduce: quantized_ring }``
               (the int8-on-the-wire ring, ops/quantized_collective.py)
+  q8_hier     q8_overlap + ``kernels { grad_allreduce: q8_hier }`` with
+              ``ring { intra_degree: 2 }`` (the two-level hierarchical
+              ring: f32 intra-slice hops, int8 inter-slice hops)
 
 and printing one JSON line::
 
@@ -47,8 +50,19 @@ the analytic ppermute-payload model
 (``quantized_collective.modeled_wire_bytes``) and the step jaxpr's
 actual ppermute operand bytes (``ppermute_wire_bytes`` — the program,
 not a clock), so the ~3.9x int8 byte drop carries on CPU hosts where
-wall-clock A/B of a per-shard emulated program is noise. ``pass_mode``
-/ ``ring_pass_mode`` in the JSON say which criterion carried. The
+wall-clock A/B of a per-shard emulated program is noise. Gate 3 (the
+q8_hier arm, same pattern): the hierarchical step stays within
+``threshold`` x exact OR its deterministic arm holds — the PER-LEVEL
+modeled bytes (``modeled_wire_bytes_levels``) equal the per-level
+jaxpr-counted ppermute bytes (``ppermute_wire_bytes_levels``) on both
+levels AND the scarce inter-slice bytes times ``intra_degree`` stay at
+or under the flat single-level ring's bytes (the exact K(M-1) <= KM-1
+identity: the hierarchy never pays MORE on the slow wire than the flat
+ring would). At the default ``--ndata 2`` the factored 2x1 geometry is
+degenerate (no inter hops — the gate holds trivially); CI runs the
+real 2x2 arm with ``--ndata 4 --head 12`` (the 12-wide head keeps
+every param chunkable by 4). ``pass_mode`` / ``ring_pass_mode`` /
+``hier_pass_mode`` in the JSON say which criterion carried. The
 exact mode is the unchanged baseline by construction: an inert/absent
 grad_comm block traces the identical program (tests/test_grad_comm.py
 pins this at the jaxpr level).
@@ -65,8 +79,8 @@ tools/trace.py --summarize's comm share.
 Usage::
 
   python -m singa_tpu.tools.collective_stall [--steps N] [--warmup N]
-      [--trials N] [--batch N] [--hidden N] [--ndata N] [--buckets N]
-      [--dtype int8|bf16] [--zero_update] [--threshold R]
+      [--trials N] [--batch N] [--hidden N] [--head N] [--ndata N]
+      [--buckets N] [--dtype int8|bf16] [--zero_update] [--threshold R]
 """
 
 from __future__ import annotations
@@ -204,6 +218,11 @@ def _mode_conf(mode: str, dtype: str, buckets: int) -> str:
         "overlap": f"grad_comm {{ mode: exact buckets: {buckets} }}",
         "q8_overlap": q8b,
         "q8_ring": q8b + "\nkernels { grad_allreduce: quantized_ring }",
+        "q8_hier": (
+            q8b
+            + "\nkernels { grad_allreduce: q8_hier }"
+            + "\nring { intra_degree: 2 }"
+        ),
     }
     return blocks[mode]
 
@@ -219,11 +238,18 @@ def measure_wire_bytes(trainer) -> dict:
     ``quantized_ring`` is the ring's modeled ppermute payload, and
     ``ring_jaxpr`` re-counts it from the step jaxpr's actual ppermute
     operand bytes x trip counts — the gated model must match what the
-    program sends (tests pin equality)."""
+    program sends (tests pin equality). A ``q8_hier`` trainer carries
+    the per-level split both ways: modeled ``intra``/``inter`` (+
+    ``flat_ring``, the same-n single-level baseline) from the trainer's
+    model, ``ring_jaxpr_intra``/``ring_jaxpr_inter`` from the jaxpr
+    (``ppermute_wire_bytes_levels``), with ``ring_jaxpr`` their sum."""
     import jax
     import jax.numpy as jnp
 
-    from ..ops.quantized_collective import ppermute_wire_bytes
+    from ..ops.quantized_collective import (
+        ppermute_wire_bytes,
+        ppermute_wire_bytes_levels,
+    )
 
     assert trainer._comm is not None and trainer._comm.ring
     out = trainer.wire_bytes_model()
@@ -233,13 +259,23 @@ def measure_wire_bytes(trainer) -> dict:
         trainer.params, trainer.state, trainer.buffers, jnp.int32(0),
         batch, rng,
     )
-    out["ring_jaxpr"] = int(ppermute_wire_bytes(jaxpr))
+    if trainer._comm.hier and trainer._ring_hier is not None:
+        intra_ax, inter_ax, k, _ = trainer._ring_hier
+        levels = ppermute_wire_bytes_levels(
+            jaxpr, intra_axis=intra_ax, inter_axis=inter_ax,
+            intra_degree=k,
+        )
+        out["ring_jaxpr_intra"] = int(levels["intra"])
+        out["ring_jaxpr_inter"] = int(levels["inter"])
+        out["ring_jaxpr"] = int(levels["intra"] + levels["inter"])
+    else:
+        out["ring_jaxpr"] = int(ppermute_wire_bytes(jaxpr))
     return out
 
 
 def _make_runner(shard: str, batch: int, hidden: int, warmup: int,
                  mode: str, dtype: str, buckets: int, ndata: int,
-                 zero: bool):
+                 zero: bool, head: int = 10):
     """-> (trainer, window(steps) -> seconds) for one grad_comm mode.
 
     Every mode runs the identical per-step sync loop on the same
@@ -253,7 +289,8 @@ def _make_runner(shard: str, batch: int, hidden: int, warmup: int,
     from ..trainer import Trainer
     from .input_stall import _CONF
 
-    text = _CONF.format(shard=shard, batch=batch, hidden=hidden)
+    text = _CONF.format(shard=shard, batch=batch, hidden=hidden,
+                        head=head)
     block = _mode_conf(mode, dtype, buckets)
     if block:
         text += "\n" + block + "\n"
@@ -264,11 +301,14 @@ def _make_runner(shard: str, batch: int, hidden: int, warmup: int,
         cfg, seed=0, log=lambda s: None, mesh=mesh,
         prefetch=False, device_cache=False,
     )
-    quant = ("quantized", "q8_overlap", "q8_ring")
+    quant = ("quantized", "q8_overlap", "q8_ring", "q8_hier")
     want = "quantized" if mode in quant else "exact"
     assert trainer.comm_mode == want, (mode, trainer.comm_mode)
-    assert (mode == "q8_ring") == (
+    assert (mode in ("q8_ring", "q8_hier")) == (
         trainer._comm is not None and trainer._comm.ring
+    ), mode
+    assert (mode == "q8_hier") == (
+        trainer._comm is not None and trainer._comm.hier
     ), mode
 
     def sync() -> float:
@@ -294,7 +334,9 @@ def _make_runner(shard: str, batch: int, hidden: int, warmup: int,
     return trainer, window
 
 
-MODES = ("exact", "quantized", "overlap", "q8_overlap", "q8_ring")
+MODES = (
+    "exact", "quantized", "overlap", "q8_overlap", "q8_ring", "q8_hier",
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -314,6 +356,11 @@ def main(argv: list[str] | None = None) -> int:
     # honest small share it is on real models
     ap.add_argument("--batch", type=int, default=8192)
     ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument(
+        "--head", type=int, default=10,
+        help="classifier width; 12 keeps every param chunkable when "
+        "--ndata 4 hosts the real 2x2 hierarchical geometry",
+    )
     ap.add_argument("--records", type=int, default=8192,
                     help="synthetic dataset size")
     ap.add_argument("--ndata", type=int, default=2,
@@ -363,6 +410,7 @@ def main(argv: list[str] | None = None) -> int:
         mode: _make_runner(
             shard, args.batch, args.hidden, args.warmup, mode,
             args.dtype, args.buckets, args.ndata, args.zero_update,
+            head=args.head,
         )
         for mode in MODES
     }
@@ -403,18 +451,39 @@ def main(argv: list[str] | None = None) -> int:
     ring_ratio_ok = ring_ratio <= args.threshold
     wire_ok = wire_model_ok and (wire_ratio or 0) >= args.wire_threshold
     ring_ok = ring_ratio_ok or wire_ok
+    # --- gate 3: the hierarchical two-level ring. Deterministic arm:
+    # the per-level analytic model matches the per-level jaxpr count on
+    # BOTH levels, and the scarce inter-slice bytes x intra_degree stay
+    # at or under the flat same-n ring (K(M-1) <= KM-1, exact) ---
+    hwire = measure_wire_bytes(runners["q8_hier"][0])
+    hier_deg = int(hwire.get("intra_degree", 1))
+    hier_model_ok = (
+        hwire.get("intra") == hwire.get("ring_jaxpr_intra")
+        and hwire.get("inter") == hwire.get("ring_jaxpr_inter")
+    )
+    hier_wire_ok = hier_model_ok and (
+        hwire.get("inter", 0) * hier_deg <= hwire.get("flat_ring", 0)
+    )
+    hier_ratio = ms["q8_hier"] / ms["exact"]
+    hier_ratio_ok = hier_ratio <= args.threshold
+    hier_ok = hier_ratio_ok or hier_wire_ok
     out = {
         "exact_step_ms": round(ms["exact"], 3),
         "quantized_step_ms": round(ms["quantized"], 3),
         "overlap_step_ms": round(ms["overlap"], 3),
         "q8_overlap_step_ms": round(ms["q8_overlap"], 3),
         "q8_ring_step_ms": round(ms["q8_ring"], 3),
+        "q8_hier_step_ms": round(ms["q8_hier"], 3),
         "quantized_ratio": round(ms["quantized"] / ms["exact"], 3),
         "overlap_ratio": round(ms["overlap"] / ms["exact"], 3),
         "q8_overlap_ratio": round(ratio, 3),
         "q8_ring_ratio": round(ring_ratio, 3),
+        "q8_hier_ratio": round(hier_ratio, 3),
         "comm_ms": comm_ms,
         "wire_bytes": wire,
+        "hier_wire_bytes": hwire,
+        "hier_model_matches_jaxpr": hier_model_ok,
+        "hier_intra_degree": hier_deg,
         "wire_bytes_ratio": round(wire_ratio, 3) if wire_ratio else None,
         "wire_model_matches_jaxpr": wire_model_ok,
         "wire_threshold": args.wire_threshold,
@@ -435,10 +504,15 @@ def main(argv: list[str] | None = None) -> int:
             if ring_ok
             else None
         ),
-        "pass": ok and ring_ok,
+        "hier_pass_mode": (
+            ("step_ratio" if hier_ratio_ok else "wire_bytes")
+            if hier_ok
+            else None
+        ),
+        "pass": ok and ring_ok and hier_ok,
     }
     print(json.dumps(out))
-    return 0 if (ok and ring_ok) else 1
+    return 0 if (ok and ring_ok and hier_ok) else 1
 
 
 if __name__ == "__main__":

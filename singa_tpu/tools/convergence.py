@@ -12,7 +12,7 @@ reference length and width:
               5000 train / 1000 test with disjoint noise)
 
 Usage:  python -m singa_tpu.tools.convergence [mlp mlp_elastic conv alexnet]
-            [--grad_comm exact|q8|q8wire|bf16] [--steps N]
+            [--grad_comm exact|q8|q8wire|q8hier|bf16] [--steps N]
             [--hidden_scale R] [--batch N]
 
 Prints one JSON line per workload: {name, steps, wall_sec,
@@ -35,7 +35,11 @@ convergence over a whole run, not just one step; the ``q8wire`` arm
 re-runs it through the ring and holds the SAME bar against ``q8``,
 proving the per-hop re-quantization (whose wire rounding goes
 un-fed-back — the documented one-shot-EF caveat) does not move
-convergence.
+convergence. ``q8hier`` is the two-level hierarchical ring
+(``kernels { grad_allreduce: q8_hier }`` + ``ring { intra_degree: 2 }``
+— the data axis must be even; f32 intra-slice hops, int8 inter-slice
+hops) held to the same bar; the true 2x2 factored-mesh parity runs in
+tests/test_quantized_collective.py's hier suite.
 ``--steps`` / ``--hidden_scale`` / ``--batch`` shrink the run for
 CPU-hosted CI (hidden_scale scales kInnerProduct widths, keeping the
 10-class head, like __graft_entry__._flagship_cfg); full-length parity
@@ -199,7 +203,8 @@ def main(argv: list[str]) -> int:
     ap.add_argument("workloads", nargs="*",
                     default=["mlp", "mlp_elastic", "conv", "alexnet"])
     ap.add_argument("--grad_comm", default="",
-                    choices=("", "exact", "q8", "q8wire", "bf16"),
+                    choices=("", "exact", "q8", "q8wire", "q8hier",
+                             "bf16"),
                     help="gradient-collective mode (q8 = quantized int8 "
                     "with error feedback; q8wire = q8 through the "
                     "int8-on-the-wire quantized ring, kernels { "

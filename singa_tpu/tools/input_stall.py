@@ -82,7 +82,7 @@ neuralnet {{
     name: "fc2"
     type: "kInnerProduct"
     srclayers: "tanh1"
-    inner_product_param {{ num_output: 10 }}
+    inner_product_param {{ num_output: {head} }}
     param {{ name: "weight" init_method: kUniform low: -0.05 high: 0.05 }}
     param {{ name: "bias" init_method: kConstant value: 0 }}
   }}
@@ -114,7 +114,7 @@ def _make_runner(shard: str, batch: int, hidden: int, warmup: int,
     from ..trainer import Trainer
 
     cfg = parse_model_config(_CONF.format(shard=shard, batch=batch,
-                                          hidden=hidden))
+                                          hidden=hidden, head=10))
     trainer = Trainer(
         cfg, seed=0, log=lambda s: None,
         prefetch=mode != "sync",

@@ -115,7 +115,7 @@ def _make_runner(shard: str, batch: int, hidden: int, warmup: int,
     from .input_stall import _CONF
 
     cfg = parse_model_config(_CONF.format(shard=shard, batch=batch,
-                                          hidden=hidden))
+                                          hidden=hidden, head=10))
     cfg.zero_update = zero
     mesh = build_mesh(ndata, 1, jax.devices()[:ndata])
     trainer = Trainer(

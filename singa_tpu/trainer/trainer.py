@@ -181,7 +181,7 @@ class Trainer:
         from ..parallel.collectives import GradCommSpec
 
         self._comm = GradCommSpec.from_config(
-            model_cfg.grad_comm, model_cfg.kernels
+            model_cfg.grad_comm, model_cfg.kernels, model_cfg.ring
         )
         if self._comm is not None and not self._supports_grad_comm:
             raise ConfigError(
@@ -218,6 +218,9 @@ class Trainer:
         # the same fail-early contract as the fused-attention kernel ---
         self._ring_chunk_dims: dict[str, int] | None = None
         self._ring_gather: dict[str, bool] | None = None
+        #: hierarchical two-level geometry (intra_axis, inter_axis, K,
+        #: M) from hier_ring_geometry — None for the flat ring
+        self._ring_hier: tuple | None = None
         if self._comm is not None and self._comm.ring:
             self._setup_ring_collective()
 
@@ -880,44 +883,90 @@ class Trainer:
     def grad_wire_impl(self) -> str:
         """Which wire implementation the data-axis gradient reduction
         runs ("" when no grad_comm machinery is active): ``reference``
-        (quantize around the GSPMD psum — fp32 bytes on the wire) or
-        ``quantized_ring`` (int8 bytes in explicit ppermutes)."""
+        (quantize around the GSPMD psum — fp32 bytes on the wire),
+        ``quantized_ring`` (int8 bytes in explicit ppermutes), or
+        ``q8_hier`` (the hierarchical two-level ring)."""
         if self._comm is None:
             return ""
         return self._comm.wire_impl
 
     def _ring_ndata(self) -> int:
+        """Total reduction width: the data-axis width for the flat
+        ring, K*M for the hierarchical form (the named-axes variant
+        reduces over the PRODUCT of its two mesh axes)."""
+        if self._ring_hier is not None:
+            return self._ring_hier[2] * self._ring_hier[3]
         return dict(self.mesh.shape).get("data", 1)
+
+    def _ring_axes(self) -> tuple:
+        """Mesh axes the ring's chunk layout shards over, major-first
+        (chunk index = g*K + p, so the inter axis is the major one)."""
+        if self._ring_hier is not None:
+            intra_ax, inter_ax, _, _ = self._ring_hier
+            if intra_ax != inter_ax:
+                return (inter_ax, intra_ax)
+        return ("data",)
 
     def _setup_ring_collective(self) -> None:
         """Resolve the ring's per-param geometry and reject un-runnable
         configs loudly at construction (netlint KRN002 is the static
-        mirror, consulting the SAME ``ring_reducible`` predicate)."""
-        from ..ops.quantized_collective import ring_fusable, ring_reducible
+        mirror, consulting the SAME ``ring_reducible`` /
+        ``hier_ring_geometry`` predicates). The flat ring keeps its
+        loud composed-mesh rejection; ``q8_hier`` is the acceptance
+        path — any mesh whose reduction the two-level factorization
+        covers runs, with the chunkability predicates applied at the
+        TOTAL width K*M."""
+        from ..ops.quantized_collective import (
+            hier_ring_geometry,
+            ring_fusable,
+            ring_reducible,
+        )
 
+        impl = self._comm.wire_impl
         if not self._supports_ring_collective:
             raise ConfigError(
                 f"{type(self).__name__} does not support kernels "
-                "{ grad_allreduce: quantized_ring } (the ring wraps the "
+                f"{{ grad_allreduce: {impl} }} (the ring wraps the "
                 "backward in a data-axis shard_map; this engine's step "
                 "does not take that shape)"
             )
         widths = dict(self.mesh.shape)
-        other = {a: w for a, w in widths.items() if a != "data" and w > 1}
-        if other:
-            raise ConfigError(
-                "kernels { grad_allreduce: quantized_ring } runs over "
-                f"the data axis only, but the mesh also shards {other} "
-                "— hierarchical (intra/inter-slice) two-level rings are "
-                "a ROADMAP carry-over"
-            )
+        if self._comm.hier:
+            geom = hier_ring_geometry(widths, self._comm)
+            if isinstance(geom, str):
+                raise ConfigError(
+                    f"kernels {{ grad_allreduce: q8_hier }} cannot "
+                    f"run: {geom}"
+                )
+            if geom[0] != geom[1] and self._zero_sh is not None:
+                raise ConfigError(
+                    "kernels { grad_allreduce: q8_hier } with named "
+                    "intra_axis/inter_axis does not compose with "
+                    "zero_update (the update layout shards over the "
+                    "data axis only) — use the factored "
+                    "ring { intra_degree } form"
+                )
+            self._ring_hier = geom
+        else:
+            other = {
+                a: w for a, w in widths.items() if a != "data" and w > 1
+            }
+            if other:
+                raise ConfigError(
+                    "kernels { grad_allreduce: quantized_ring } runs over "
+                    f"the data axis only, but the mesh also shards {other} "
+                    "— kernels { grad_allreduce: q8_hier } with a "
+                    "ring { intra_axis/inter_axis } block is the "
+                    "hierarchical (intra/inter-slice) two-level form "
+                    "that covers composed meshes"
+                )
         ndata = self._ring_ndata()
         bs = self.train_net.batchsize
         if bs % max(1, ndata):
             raise ConfigError(
-                f"quantized_ring needs the data axis ({ndata}) to divide "
-                f"the batch ({bs}): each shard computes its own local "
-                "partial gradients"
+                f"{impl} needs the data-reduction width ({ndata}) to "
+                f"divide the batch ({bs}): each shard computes its own "
+                "local partial gradients"
             )
         if self.train_net.buffer_specs():
             # batch-stat layers (kBatchNorm is the only buffer owner)
@@ -927,7 +976,7 @@ class Trainer:
             # so batch moments would silently become per-shard stats —
             # a biased variance, not the documented tolerance caveat
             raise ConfigError(
-                "kernels { grad_allreduce: quantized_ring } cannot run "
+                f"kernels {{ grad_allreduce: {impl} }} cannot run "
                 "a net with batch-statistics buffers (kBatchNorm): the "
                 "ring's per-shard backward would turn sync BatchNorm "
                 "into local-shard BN — cross-shard batch moments inside "
@@ -950,7 +999,7 @@ class Trainer:
         reason = ring_reducible(shapes, ndata, chunk_dims)
         if reason is not None:
             raise ConfigError(
-                f"kernels.grad_allreduce quantized_ring cannot run: "
+                f"kernels.grad_allreduce {impl} cannot run: "
                 f"{reason}"
             )
         if not self._comm.interpret:
@@ -959,7 +1008,7 @@ class Trainer:
             )
             if reason is not None:
                 raise ConfigError(
-                    "kernels.grad_allreduce quantized_ring with "
+                    f"kernels.grad_allreduce {impl} with "
                     f"interpret off cannot run: {reason}"
                 )
         self._ring_chunk_dims = chunk_dims
@@ -993,8 +1042,10 @@ class Trainer:
 
             d = self._ring_chunk_dims.get(name[len(RESIDUAL_PREFIX):])
             if d is not None:
+                axes = self._ring_axes()
+                entry = axes if len(axes) > 1 else axes[0]
                 return NamedSharding(
-                    self.mesh, P(*([None] * d + ["data"]))
+                    self.mesh, P(*([None] * d + [entry]))
                 )
         return self._repl
 
@@ -1009,9 +1060,12 @@ class Trainer:
 
         from ..parallel.collectives import residual_key
 
+        axes = self._ring_axes()
+        entry = axes if len(axes) > 1 else axes[0]
+
         def cspec(name):
             d = self._ring_chunk_dims[name]
-            return P(*([None] * d + ["data"]))
+            return P(*([None] * d + [entry]))
 
         gspecs = {
             n: (P() if self._ring_gather[n] else cspec(n))
@@ -1050,6 +1104,9 @@ class Trainer:
 
         spec = self._comm
         ndata = self._ring_ndata()
+        hier = self._ring_hier
+        axes = self._ring_axes()
+        bentry = axes if len(axes) > 1 else axes[0]
         buckets = self._comm_buckets(frozenset(params))
         res_in = {
             k: v for k, v in buffers.items() if is_residual_key(k)
@@ -1060,7 +1117,14 @@ class Trainer:
         gspecs, rspecs = self._ring_specs()
 
         def body(params, passthru, res, batch, rng):
-            me = jax.lax.axis_index("data")
+            if len(axes) > 1:
+                # named-axes hier: linear rank = g*K + p (the batch's
+                # composite in_spec slices in the same order)
+                me = jax.lax.axis_index(axes[0]) * hier[2] + (
+                    jax.lax.axis_index(axes[1])
+                )
+            else:
+                me = jax.lax.axis_index("data")
             lrng = jax.random.fold_in(rng, me)
 
             def loss_fn(p):
@@ -1088,6 +1152,7 @@ class Trainer:
                 residual_key=residual_key,
                 fused_hop=not spec.interpret,
                 fused_interpret=False,
+                hier=hier,
             )
 
             def fold(tree):
@@ -1096,14 +1161,14 @@ class Trainer:
                 # pytree) pass through untouched — they entered
                 # replicated and nothing here wrote them
                 return jax.tree.map(
-                    lambda x: jax.lax.pmean(x, "data")
+                    lambda x: jax.lax.pmean(x, axes)
                     if jnp.issubdtype(x.dtype, jnp.floating)
                     else x,
                     tree,
                 )
 
             return (
-                jax.lax.pmean(loss, "data"),
+                jax.lax.pmean(loss, axes),
                 fold(metrics),
                 fold(new_buffers),
                 grads,
@@ -1113,7 +1178,7 @@ class Trainer:
         fn = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(), P(), rspecs, P("data"), P()),
+            in_specs=(P(), P(), rspecs, P(bentry), P()),
             out_specs=(P(), P(), P(), gspecs, rspecs),
             check_rep=False,
         )
@@ -1166,6 +1231,7 @@ class Trainer:
                 residual_key=residual_key,
                 fused_hop=not spec.interpret,
                 fused_interpret=False,
+                hier=self._ring_hier,
             )
 
         fn = shard_map(
@@ -1191,22 +1257,37 @@ class Trainer:
         width the chunking could not actually divide is halved until
         ``ring_reducible`` accepts it (never below the real width), so
         the model's floor divisions stay exact and the priced geometry
-        is one the ring could really run."""
+        is one the ring could really run. Under ``q8_hier`` the dict
+        additionally carries the per-level split — ``intra`` /
+        ``inter`` / ``intra_degree`` — with ``quantized_ring`` staying
+        the active ring's TOTAL (intra + inter), so every downstream
+        consumer of the total keeps working unchanged."""
         from ..ops.quantized_collective import (
             modeled_wire_bytes,
+            modeled_wire_bytes_levels,
             reference_wire_bytes,
             ring_reducible,
         )
 
         if self._comm is None:
             return None
+        hier_k = 0
+        if self._comm.hier and self._ring_hier is not None:
+            hier_k = self._ring_hier[2]
+            if ndata is not None and ndata > self._ring_ndata() and (
+                self._comm.intra_degree > 0
+            ):
+                # nominal pricing keeps the CONFIGURED factored degree
+                # (the host's real axis may be 1-wide, degenerating the
+                # runtime geometry to 1x1)
+                hier_k = self._comm.intra_degree
         n = self._ring_ndata() if ndata is None else ndata
         if ndata is not None and n > self._ring_ndata():
             shapes = {nm: s.shape for nm, s in self.specs.items()}
-            while (
-                n > self._ring_ndata()
-                and ring_reducible(shapes, n, self._ring_chunk_dims)
+            while n > self._ring_ndata() and (
+                ring_reducible(shapes, n, self._ring_chunk_dims)
                 is not None
+                or (hier_k > 1 and n % hier_k)
             ):
                 n //= 2
             n = max(n, self._ring_ndata())
@@ -1214,7 +1295,7 @@ class Trainer:
             nm: int(np.prod(s.shape, dtype=np.int64))
             for nm, s in self.specs.items()
         }
-        return {
+        out = {
             "reference": int(
                 reference_wire_bytes(
                     sizes, n, scatter_only=self._zero_sh is not None
@@ -1228,6 +1309,22 @@ class Trainer:
             ),
             "ndata": n,
         }
+        if hier_k:
+            k = hier_k if n % hier_k == 0 else 1
+            # the flat single-level ring over the same n — the baseline
+            # the hierarchical gate (inter x intra_degree <= flat)
+            # divides against
+            out["flat_ring"] = out["quantized_ring"]
+            levels = modeled_wire_bytes_levels(
+                sizes, self._comm_buckets(frozenset(sizes)), n,
+                intra_degree=k, dtype=self._comm.dtype,
+                gather=self._ring_gather,
+            )
+            out["quantized_ring"] = levels["total"]
+            out["intra"] = levels["intra"]
+            out["inter"] = levels["inter"]
+            out["intra_degree"] = k
+        return out
 
     def modeled_wire_bytes_per_step(self) -> int:
         """Modeled per-device bytes the ACTIVE gradient collective

@@ -113,6 +113,35 @@ def test_ring_wire_bytes_parity(shard, zero):
         assert "zero param allgather (f32)" in rows
 
 
+def test_hier_wire_bytes_split_parity(shard):
+    """Under q8_hier the single ring row splits into intra-slice (f32)
+    and inter-slice (int8) rows, each equal to the per-level analytic
+    model AND the per-level jaxpr attribution — and the sum stays the
+    trainer's reported total (COST001 keeps pricing the whole wire)."""
+    from singa_tpu.ops.quantized_collective import (
+        ppermute_wire_bytes_levels,
+    )
+    from test_quantized_collective import MLP12_CONF, Q8B_HIER
+
+    cfg = parse_model_config(MLP12_CONF.format(
+        shard=shard, zero="false", train_steps=4, checkpoint_frequency=0,
+        checkpoint_format="npz", extra=Q8B_HIER,
+    ))
+    t = _mk(cfg, ndata=4)
+    report = build_cost_model(cfg, {"data": 4}, "t.conf")
+    rows = dict(report.collectives)
+    intra = rows["grad ring intra-slice (f32 wire)"]
+    inter = rows["grad ring inter-slice (int8 wire)"]
+    assert "grad ring reduce (int8 wire)" not in rows
+    wm = t.wire_bytes_model()
+    assert (intra, inter) == (wm["intra"], wm["inter"])
+    levels = ppermute_wire_bytes_levels(_step_jaxpr(t), intra_degree=2)
+    assert (intra, inter) == (levels["intra"], levels["inter"])
+    assert intra + inter == t.modeled_wire_bytes_per_step()
+    # the scarce-hop gate the hierarchy exists for
+    assert inter * 2 <= wm["flat_ring"]
+
+
 def test_reference_wire_bytes_parity(shard):
     """Without the ring the model prices the fp32 collective the
     trainer itself models (reference_wire_bytes, shared formula)."""
@@ -348,6 +377,37 @@ def test_explain_cost_report_through_cli(shard, tmp_path, capsys):
     t = _mk(_cfg(shard, extra=Q8B_RING))
     assert str(t.opt_state_bytes_per_device()) in out
     assert str(t.modeled_wire_bytes_per_step()) in out
+
+
+def test_explain_cost_inter_slice_bandwidth_row(shard, tmp_path, capsys):
+    """cluster { inter_slice_bandwidth } turns the hierarchical split
+    into a DCN transfer-time row in --explain-cost; without the
+    declaration the split rows render but the time row stays silent."""
+    from test_quantized_collective import MLP12_CONF, Q8B_HIER
+
+    p = tmp_path / "job.conf"
+    p.write_text(MLP12_CONF.format(
+        shard=shard, zero="false", train_steps=4, checkpoint_frequency=0,
+        checkpoint_format="npz", extra=Q8B_HIER,
+    ))
+    cl = tmp_path / "cluster.conf"
+    cl.write_text(
+        'workspace: "ws"\nnworkers: 4\n'
+        "inter_slice_bandwidth: 25000000000\n"
+    )
+    rc = lint_cli.main([str(p), "--cluster", str(cl), "--explain-cost"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "grad ring intra-slice (f32 wire)" in out
+    assert "grad ring inter-slice (int8 wire)" in out
+    assert "grad ring reduce" not in out
+    assert "inter-slice transfer/step" in out and "DCN" in out
+    cl2 = tmp_path / "c2.conf"
+    cl2.write_text('workspace: "ws"\nnworkers: 4\n')
+    lint_cli.main([str(p), "--cluster", str(cl2), "--explain-cost"])
+    out2 = capsys.readouterr().out
+    assert "grad ring inter-slice (int8 wire)" in out2
+    assert "inter-slice transfer/step" not in out2
 
 
 def test_mem001_and_cost001_through_cli(shard, tmp_path, capsys):

@@ -28,8 +28,11 @@ from singa_tpu.config.schema import ClusterConfig, ConfigError
 from singa_tpu.data.loader import synthetic_arrays, write_records
 from singa_tpu.ops.quantized_collective import (
     dequantize_int8,
+    hier_ring_geometry,
     modeled_wire_bytes,
+    modeled_wire_bytes_levels,
     ppermute_wire_bytes,
+    ppermute_wire_bytes_levels,
     quant_acc,
     quantize_int8,
     reference_wire_bytes,
@@ -894,3 +897,359 @@ def test_ppermute_wire_bytes_counts_scans():
     jaxpr = jax.make_jaxpr(fn)(jnp.zeros((8, 4), jnp.int8))
     # per shard: (4, 4) int8 = 16 bytes x 3 trips
     assert ppermute_wire_bytes(jaxpr) == 48
+
+
+# ---------------------------------------------------------------------------
+# the hierarchical two-level ring (q8_hier): intra-slice x inter-slice
+# ---------------------------------------------------------------------------
+
+# fc2's 12-wide head keeps every param chunkable by a 4-wide reduction
+# (the stock conf's (10,) bias is not — that indivisibility is itself a
+# pinned rejection arm above)
+MLP12_CONF = MLP_CONF.replace("num_output: 10", "num_output: 12")
+Q8B = "grad_comm { mode: quantized dtype: int8 buckets: 2 }"
+HIER = "kernels { grad_allreduce: q8_hier }\nring { intra_degree: 2 }"
+Q8B_HIER = Q8B + "\n" + HIER
+NAMED = (
+    Q8B + "\nkernels { grad_allreduce: q8_hier }\n"
+    'ring { intra_axis: "model" inter_axis: "data" }'
+)
+
+
+def _cfg12(shard, *, extra="", zero=False, train_steps=12,
+           checkpoint_frequency=0, checkpoint_format="npz"):
+    return parse_model_config(MLP12_CONF.format(
+        shard=shard, zero="true" if zero else "false",
+        train_steps=train_steps, checkpoint_frequency=checkpoint_frequency,
+        checkpoint_format=checkpoint_format, extra=extra,
+    ))
+
+
+def test_hier_geometry_predicate():
+    """The pure geometry gate, every arm: factored, named, degenerate,
+    and each reason string the trainer/KRN002 surface."""
+    # factored: intra_degree splits the data axis
+    from singa_tpu.config.schema import RingConfig
+
+    ring = RingConfig(intra_degree=2)
+    assert hier_ring_geometry({"data": 4}, ring) == ("data", "data", 2, 2)
+    assert hier_ring_geometry({"data": 8}, ring) == ("data", "data", 2, 4)
+    # degenerate n<=1: accepted as the 1x1 no-hop ring (bench hosts)
+    assert hier_ring_geometry({"data": 1}, ring) == ("data", "data", 1, 1)
+    # named: two distinct mesh axes, inter-major
+    named = RingConfig(intra_axis="model", inter_axis="data")
+    assert hier_ring_geometry({"data": 2, "model": 2}, named) == (
+        "model", "data", 2, 2
+    )
+    # reasons, not tuples
+    assert "needs a ring {}" in hier_ring_geometry({"data": 4}, None)
+    assert "does not divide" in hier_ring_geometry(
+        {"data": 4}, RingConfig(intra_degree=3)
+    )
+    assert "factors the 'data' axis only" in hier_ring_geometry(
+        {"data": 4, "model": 2}, ring
+    )
+    assert "mutually exclusive" in hier_ring_geometry(
+        {"data": 4}, RingConfig(intra_degree=2, intra_axis="data",
+                                inter_axis="data")
+    )
+    assert "BOTH axes" in hier_ring_geometry(
+        {"data": 4}, RingConfig(intra_axis="data")
+    )
+    assert "same mesh axis" in hier_ring_geometry(
+        {"data": 4}, RingConfig(intra_axis="data", inter_axis="data")
+    )
+    assert "names no mesh axis" in hier_ring_geometry(
+        {"data": 2, "model": 2},
+        RingConfig(intra_axis="modle", inter_axis="data"),
+    )
+    assert "not covered" in hier_ring_geometry(
+        {"data": 2, "model": 2, "expert": 2},
+        RingConfig(intra_axis="model", inter_axis="expert"),
+    )
+    assert "outside the" in hier_ring_geometry(
+        {"data": 2, "model": 2, "expert": 2},
+        RingConfig(intra_axis="model", inter_axis="data"),
+    )
+
+
+def test_q8hier_cli_tag():
+    """apply_grad_comm_tag's q8hier shorthand = q8 + the hierarchical
+    knob + a default factored ring { intra_degree: 2 } block."""
+    from singa_tpu.config.schema import ModelConfig
+    from singa_tpu.parallel import apply_grad_comm_tag
+
+    cfg = apply_grad_comm_tag(ModelConfig(), "q8hier")
+    assert cfg.grad_comm.mode == "quantized"
+    assert cfg.grad_comm.dtype == "int8"
+    assert cfg.kernels.grad_allreduce == "q8_hier"
+    assert cfg.ring is not None and cfg.ring.intra_degree == 2
+    with pytest.raises(ValueError, match="q8hier"):
+        apply_grad_comm_tag(ModelConfig(), "q8_heir")
+
+
+def test_hier_requires_quantized_block(shard):
+    """Same seam as the flat ring: q8_hier without an active quantized
+    grad_comm block is a construction-time ConfigError."""
+    from singa_tpu.parallel.collectives import GradCommSpec
+
+    with pytest.raises(ConfigError, match="q8_hier"):
+        GradCommSpec.from_config(
+            None, kernels=type("K", (), {"grad_allreduce": "q8_hier",
+                                         "interpret": True})(),
+        )
+
+
+def test_hier_factored_matches_flat_ring_convergence(shard):
+    """THE acceptance bar: the 2x2 factored hierarchical ring converges
+    with the flat 4-wide q8 ring — per-step losses track within float
+    noise (the intra level accumulates in f32, so the trajectories are
+    close, not bitwise) and the runs end at the same loss."""
+    th = _mk(_cfg12(shard, extra=Q8B_HIER), ndata=4)
+    assert th._comm.hier and th.grad_wire_impl == "q8_hier"
+    assert th._ring_hier == ("data", "data", 2, 2)
+    tf = _mk(_cfg12(shard, extra=Q8B_RING), ndata=4)
+    lh, lf = _loss_trace(th, 10), _loss_trace(tf, 10)
+    assert all(np.isfinite(lh)), lh
+    np.testing.assert_allclose(lh, lf, rtol=0, atol=5e-3)
+    assert lh[-1] < lh[0] * 0.75  # it actually trains
+
+
+def test_hier_named_axes_bitwise_matches_factored(shard):
+    """The named form on a REAL 2x2 composed mesh (data=2 x model=2,
+    the reduction riding both axes) produces the bitwise-identical
+    trajectory the factored 4x1 form produces — the two spellings are
+    the same algorithm over the same 4-wide reduction."""
+    tn = _mk_named(_cfg12(shard, extra=NAMED))
+    assert tn._ring_hier == ("model", "data", 2, 2)
+    tfac = _mk(_cfg12(shard, extra=Q8B_HIER), ndata=4)
+    ln, lfac = _loss_trace(tn, 6), _loss_trace(tfac, 6)
+    assert ln == lfac, (ln, lfac)
+
+
+def _mk_named(cfg, *, cl=None, seed=3, **kw):
+    mesh = build_mesh(2, 2, jax.devices()[:4])
+    kw.setdefault("prefetch", False)
+    kw.setdefault("device_cache", False)
+    return Trainer(cfg, cl, mesh=mesh, seed=seed, log=lambda s: None, **kw)
+
+
+def test_hier_wire_bytes_per_level_parity_and_gate(shard):
+    """The deterministic stall arm, per level: the analytic intra/inter
+    split equals the jaxpr-counted ppermute attribution EXACTLY (an
+    inter level that shipped f32 chunks would count 4x the model and
+    fail loudly), and the scarce-hop gate holds — inter bytes x
+    intra_degree <= the flat same-n ring's bytes (K(M-1) <= KM-1,
+    exact integers)."""
+    from singa_tpu.tools.collective_stall import measure_wire_bytes
+
+    t = _mk(_cfg12(shard, extra=Q8B_HIER), ndata=4)
+    wire = measure_wire_bytes(t)
+    assert wire["intra"] == wire["ring_jaxpr_intra"] > 0
+    assert wire["inter"] == wire["ring_jaxpr_inter"] > 0
+    assert wire["ring_jaxpr"] == wire["quantized_ring"] == (
+        wire["intra"] + wire["inter"]
+    )
+    assert wire["intra_degree"] == 2
+    assert wire["inter"] * 2 <= wire["flat_ring"]
+    # the wire inventory is int8 + f32 only (chunks, planes, scales)
+    wires = _ppermute_dtypes(_step_jaxpr(t))
+    assert {d for d, _ in wires} == {"int8", "float32"}
+    # trainer-facing total (what kernel_select reports) is the hier sum
+    assert t.modeled_wire_bytes_per_step() == wire["quantized_ring"]
+
+
+def test_modeled_wire_bytes_levels_formula():
+    sizes = {"w": 1024, "b": 64}
+    buckets = (("w",), ("b",))
+    n, K = 4, 2
+    M = n // K
+    got = modeled_wire_bytes_levels(sizes, buckets, n, intra_degree=K)
+    intra = inter = 0
+    for (nm,) in buckets:
+        chunk = sizes[nm] // n
+        intra += (K - 1) * M * chunk * 4  # f32 reduce planes
+        intra += (K - 1) * (M * chunk * 1 + M * 4)  # int8 gather planes
+        inter += (M - 1) * (chunk * 1 + 4) * 2  # reduce + gather hops
+    assert got == {"intra": intra, "inter": inter,
+                   "total": intra + inter}
+    # zero_update's gather map skips the allgather phases per param
+    gz = modeled_wire_bytes_levels(
+        sizes, buckets, n, intra_degree=K,
+        gather={"w": False, "b": True},
+    )
+    wchunk = sizes["w"] // n
+    assert gz["intra"] == intra - (K - 1) * (M * wchunk + M * 4)
+    assert gz["inter"] == inter - (M - 1) * (wchunk + 4)
+    # the scarce-hop identity vs the flat ring, same sizes/buckets
+    flat = modeled_wire_bytes(sizes, buckets, n, dtype="int8")
+    assert got["inter"] * K <= flat
+    # degenerate + indivisible arms
+    assert modeled_wire_bytes_levels(
+        sizes, buckets, 1, intra_degree=2
+    ) == {"intra": 0, "inter": 0, "total": 0}
+    with pytest.raises(ValueError, match="does not divide"):
+        modeled_wire_bytes_levels(sizes, buckets, 4, intra_degree=3)
+
+
+def test_ppermute_levels_rejects_flat_ring_perm(shard):
+    """Feeding a FLAT ring's program to the per-level classifier raises
+    (a 4-wide flat perm matches neither level's structure) —
+    misattribution is loud, never silent. (A 2-wide flat ring IS a
+    valid 2x1 intra ring, so the flat trainer runs at ndata=4.)"""
+    t = _mk(_cfg12(shard, extra=Q8B_RING), ndata=4)
+    with pytest.raises(ValueError, match="neither ring level"):
+        ppermute_wire_bytes_levels(_step_jaxpr(t), intra_degree=2)
+
+
+def test_hier_zero_update_composes(shard):
+    """zero_update + the factored hierarchical ring: the chunk layout
+    IS the update layout (same n-way chunking as the flat ring), the
+    run trains, and the allgather skip shows in the per-level model."""
+    t = _mk(_cfg12(shard, extra=Q8B_HIER, zero=True), ndata=4)
+    assert t._comm.hier and t._zero_sh is not None
+    losses = _loss_trace(t, 8)
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    full = _mk(_cfg12(shard, extra=Q8B_HIER), ndata=4)
+    zm, fm = t.wire_bytes_model(), full.wire_bytes_model()
+    assert zm["inter"] < fm["inter"] and zm["intra"] < fm["intra"]
+
+
+@pytest.mark.parametrize("fmt", ["npz", "sharded"])
+def test_hier_checkpoint_roundtrip_bitwise(shard, tmp_path, fmt):
+    """Error-feedback residuals under the hierarchical ring keep the
+    flat ring's chunk-sharded geometry, so a mid-run checkpoint resumes
+    bitwise — both formats, on the 2x2 factored mesh."""
+    cl = ClusterConfig()
+    cl.workspace = str(tmp_path / "ws")
+
+    def run(steps, checkpoint=None):
+        cfg = _cfg12(
+            shard,
+            extra=Q8B_HIER.replace("buckets: 2",
+                                   "buckets: 2 error_feedback: true"),
+            train_steps=steps, checkpoint_frequency=4,
+            checkpoint_format=fmt,
+        )
+        if checkpoint:
+            cfg.checkpoint = checkpoint
+        t = _mk(cfg, ndata=4, cl=cl)
+        t.run()
+        return t
+
+    full = run(12)
+    ext = "ckpt" if fmt == "sharded" else "npz"
+    ck = os.path.join(str(tmp_path / "ws"), "checkpoints", f"step_8.{ext}")
+    resumed = run(12, checkpoint=ck)
+    assert resumed.start_step == 8
+    for name in full.params:
+        np.testing.assert_array_equal(
+            np.asarray(full.params[name]),
+            np.asarray(resumed.params[name]), err_msg=name,
+        )
+    a, b = _residuals(full), _residuals(resumed)
+    assert set(a) == set(b) and a
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_hier_trainer_rejections(shard):
+    """Construction-time rejections KRN002 mirrors: broken geometry
+    carries the predicate's reason; the named form refuses
+    zero_update; the flat ring still rejects composed meshes with its
+    pinned message."""
+    with pytest.raises(ConfigError, match="does not divide"):
+        _mk(_cfg12(shard, extra=Q8B_HIER.replace(
+            "intra_degree: 2", "intra_degree: 3")), ndata=4)
+    with pytest.raises(ConfigError, match="does not compose with "
+                                          "zero_update"):
+        _mk_named(_cfg12(shard, extra=NAMED, zero=True))
+    # the un-factorable stock conf: fc2's (10,) bias can't chunk by 4
+    with pytest.raises(ConfigError, match="not divisible"):
+        _mk(_cfg(shard, extra=Q8B_HIER), ndata=4)
+    mesh = build_mesh(2, 2, jax.devices()[:4])
+    with pytest.raises(ConfigError, match="data axis only"):
+        Trainer(_cfg(shard, extra=Q8_RING), None, mesh=mesh, seed=3,
+                log=lambda s: None, prefetch=False, device_cache=False)
+
+
+def test_krn002_hier_arms(shard):
+    """The static mirror of every hierarchical rejection, with
+    did-you-means for near-miss axis names — threaded like the flat
+    arms (ring_rules directly; the CLI threading test rides
+    --cluster)."""
+    from singa_tpu.lint import Collector, ring_rules
+
+    def diags(extra, widths=None, conf=None, zero=False):
+        cfg = (_cfg12 if conf is None else conf)(shard, extra=extra)
+        if zero:
+            cfg.zero_update = True
+        col = Collector()
+        ring_rules(cfg, None, widths, "job.conf", col)
+        return [d for d in col.sorted() if d.code == "KRN002"]
+
+    q8h = Q8B + "\nkernels { grad_allreduce: q8_hier }\n"
+    # clean factored conf on a 4-wide axis: silent
+    assert not diags(Q8B_HIER, {"data": 4})
+    # >1-wide non-data axis is ACCEPTED when the named form covers it
+    # (the flat ring's pinned arm-5 rejection, relaxed under q8_hier)
+    assert not diags(
+        q8h + 'ring { intra_axis: "model" inter_axis: "data" }',
+        {"data": 2, "model": 2},
+    )
+    # no ring block
+    hits = diags(q8h, {"data": 4})
+    assert hits and "needs a ring {}" in hits[0].msg
+    # absent axis name -> did-you-mean ERROR arm
+    hits = diags(
+        q8h + 'ring { intra_axis: "modle" inter_axis: "data" }',
+        {"data": 2, "model": 2},
+    )
+    assert hits and "names no mesh axis" in hits[0].msg
+    assert "did you mean intra_axis: model?" in (hits[0].fix_hint or "")
+    # indivisible intra_degree
+    hits = diags(q8h + "ring { intra_degree: 3 }", {"data": 4})
+    assert hits and "does not divide" in hits[0].msg
+    # factored form leaves a >1-wide axis uncovered
+    hits = diags(Q8B_HIER, {"data": 4, "model": 2})
+    assert hits and "factors the 'data' axis only" in hits[0].msg
+    # named + zero_update
+    hits = diags(
+        q8h + 'ring { intra_axis: "model" inter_axis: "data" }',
+        {"data": 2, "model": 2}, zero=True,
+    )
+    assert hits and "zero_update" in hits[0].msg
+    # widths unknown (no --cluster): form-only pass stays silent on a
+    # well-formed block, loud on a malformed one
+    assert not diags(Q8B_HIER, None)
+    assert diags(q8h + 'ring { intra_axis: "x" }', None)
+    # batch arm prices the EFFECTIVE reduction width (2x2 named = 4)
+    hits = diags(
+        q8h + 'ring { intra_axis: "model" inter_axis: "data" }',
+        {"data": 3, "model": 2},
+    )
+    assert hits, "3x2 reduction cannot divide batchsize 32"
+
+
+def test_krn002_hier_through_cli(shard, tmp_path, capsys):
+    """The whole tool path for a hierarchical conf: --cluster supplies
+    the widths, the indivisible-degree arm reaches the CLI output, and
+    the clean q8_hier conf lints clean."""
+    from singa_tpu.tools import lint as lint_cli
+
+    base = MLP12_CONF.format(
+        shard=shard, zero="false", train_steps=4, checkpoint_frequency=0,
+        checkpoint_format="npz",
+        extra=Q8B + "\nkernels { grad_allreduce: q8_hier }\n"
+        "ring { intra_degree: 3 }",
+    )
+    bad = tmp_path / "bad.conf"
+    bad.write_text(base)
+    cl = tmp_path / "cluster.conf"
+    cl.write_text('workspace: "ws"\nnworkers: 4\n')
+    rc = lint_cli.main([str(bad), "--cluster", str(cl)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "KRN002" in out and "does not divide" in out
+    good = tmp_path / "good.conf"
+    good.write_text(base.replace("intra_degree: 3", "intra_degree: 2"))
+    assert lint_cli.main([str(good), "--cluster", str(cl)]) == 0
