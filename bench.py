@@ -665,6 +665,53 @@ def bench_lm_d128_prefix():
     }
 
 
+def bench_lm_d128_fleetprefix():
+    """The FLEET prefix cache on the serving shape: the shared_prefix
+    workload across two unified fleet hosts, where the measured host
+    has never seen the prompts — its only path to warm KV is a
+    cross-host cache_fetch -> cache_ship bulk frame from its peer
+    (serve/fleet/host.py). `tokens_per_s` (warm) is the row value;
+    `hit_rate`, `blocks_shipped`, `ship_bytes`, and
+    `prefill_chunk_ratio` are the deterministic numbers a regression
+    in fetch targeting, the ship codec, or slot-free install would
+    move (the chunk ratio is the host-independent or-gate arm CI
+    enforces). Identity (token_mismatches == 0 vs the cache-off cold
+    fleet) is the hard bar — shipped bytes may only skip prefill
+    work, never move a token."""
+    import io
+    from contextlib import redirect_stdout
+
+    from singa_tpu.tools import serve_bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        serve_bench.main([
+            "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+            "--requests", "12", "--max_new", "16", "--no_gate",
+            "--fleet", "--workload", "shared_prefix",
+            "--prompt_len", "48", "--block_len", "8",
+            "--prefill_chunk", "8",
+        ])
+    r = json.loads(buf.getvalue().strip().splitlines()[-1])
+    return {
+        "name": "lm_d128_fleetprefix",
+        "value": r["tokens_per_s"],
+        "unit": "tokens/sec",
+        "tokens_per_s": r["tokens_per_s"],
+        "cold_tokens_per_s": r.get("cold_tokens_per_s"),
+        "fleet_speedup": r.get("fleet_speedup"),
+        "hit_rate": r.get("hit_rate"),
+        "cache_fetches": r.get("cache_fetches"),
+        "blocks_shipped": r.get("blocks_shipped"),
+        "ship_bytes": r.get("ship_bytes"),
+        "prefill_chunk_ratio": r.get("prefill_chunk_ratio"),
+        "pass_mode": r.get("pass_mode"),
+        "token_mismatches": r.get("token_mismatches"),
+        "method": "serve_bench --fleet shared_prefix workload "
+        "(cross-host cache_ship vs cold fleet, request wall clock)",
+    }
+
+
 def bench_lm_d128_fusedattn():
     """Fused paged attention on the serving shape: the same engine as
     `lm_d128_serve` with `kernels { paged_attention: fused }` — the
@@ -743,6 +790,7 @@ BENCHES = (
     ("lm_d128_serve", bench_lm_d128_serve),
     ("lm_d128_spec", bench_lm_d128_spec),
     ("lm_d128_prefix", bench_lm_d128_prefix),
+    ("lm_d128_fleetprefix", bench_lm_d128_fleetprefix),
     ("lm_d128_fusedattn", bench_lm_d128_fusedattn),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),

@@ -750,6 +750,25 @@ class PrefixCacheConfig(Message):
         # park refcount-0 cached blocks on an LRU list instead of
         # freeing eagerly (reclaimed lazily at pool exhaustion)
         "lru": Field("bool", True),
+        # > 0: PARTIAL-TAIL sharing — sub-block digests at this token
+        # stride index a prompt's last partial block, so a prompt whose
+        # shared prefix ends mid-block copy-on-write-EXTENDS the deepest
+        # registered partial match instead of re-prefilling the whole
+        # block. Must divide kv_block_len (netlint SRV001 checks this
+        # statically). 0 = full-block granularity only.
+        "tail_stride": Field("int", 0),
+        # register FULL decode-written blocks under the same chained
+        # digest at retirement, so multi-turn conversations hit their
+        # own history. Decode-written bytes ride a different compiled
+        # shape than prefill (the PR 9 cross-shape caveat), so warm
+        # streams over these blocks are TOKEN-LEVEL identical to cold
+        # admission, not bitwise — default off preserves the bitwise
+        # guarantee.
+        "decode_blocks": Field("bool", False),
+        # fleet cross-host block shipping: how long a host holds a
+        # request awaiting a peer's cache_ship reply before degrading
+        # to plain prefill (serve/fleet/host.py; never a hang)
+        "fetch_timeout_s": Field("float", 2.0),
     }
 
 
@@ -810,6 +829,14 @@ class FleetLoadConfig(Message):
         "decode_tokens": Field("int", 0),
         # engine step rate per host (decode ticks == prefill ticks)
         "ticks_per_s": Field("float", 0.0),
+        # steady-state fraction [0, 1] of each prompt's tokens served
+        # from the warm (fleet-wide) prefix cache: discounts FLT002's
+        # prefill demand and SRV002's per-sequence block pressure so
+        # capacity planning matches a warm fleet instead of pricing
+        # every admission as a full prefill. Honored only when
+        # serving { prefix_cache { enabled } } — a declared hit rate
+        # with the cache off is wishful and is ignored.
+        "prefix_hit_rate": Field("float", 0.0),
     }
 
 

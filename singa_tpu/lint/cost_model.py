@@ -560,6 +560,23 @@ def _fmt_bytes(n: int | float) -> str:
     return f"{n:.1f} GiB"  # pragma: no cover - loop always returns
 
 
+def _declared_hit_rate(model_cfg: ModelConfig) -> float:
+    """The operator-declared expected prefix-cache hit rate
+    (``fleet { load { prefix_hit_rate } }``), clamped to [0, 1] —
+    honored by the capacity discounts ONLY when
+    ``serving.prefix_cache`` is actually enabled (a declared rate on a
+    cache-less conf is a wish, not capacity)."""
+    srv = getattr(model_cfg, "serving", None)
+    fleet = getattr(model_cfg, "fleet", None)
+    if (
+        srv is None or srv.prefix_cache is None
+        or not srv.prefix_cache.enabled
+        or fleet is None or fleet.load is None
+    ):
+        return 0.0
+    return min(1.0, max(0.0, fleet.load.prefix_hit_rate))
+
+
 def serving_cost_rules(
     model_cfg: ModelConfig,
     cluster_cfg: ClusterConfig | None,
@@ -586,21 +603,43 @@ def serving_cost_rules(
     window = _declared_window(model_cfg)
     block_len = max(1, srv.kv_block_len)
     per_seq = window // block_len if window else 0
+    hit = _declared_hit_rate(model_cfg)
     if srv.kv_blocks > 0 and per_seq > 0:
-        conc = (srv.kv_blocks - 1) // per_seq  # minus the trash block
+        # prefix-cache sharing discount: a hit admission SHARES its
+        # cached prompt blocks instead of allocating fresh ones, so at
+        # the declared fleet { load { prefix_hit_rate } } the expected
+        # per-sequence block demand drops by hit_rate x the cacheable
+        # prompt blocks. Without the declared rate (or with the cache
+        # off) the undiscounted bound stands — sizing must not assume
+        # wins the operator never promised
+        shared = 0
+        load = model_cfg.fleet.load if model_cfg.fleet else None
+        if hit > 0 and load is not None and load.prompt_tokens > 0:
+            shared = int(
+                hit * (min(load.prompt_tokens, window) // block_len)
+            )
+        per_seq_eff = max(1, per_seq - shared)
+        conc = (srv.kv_blocks - 1) // per_seq_eff  # minus the trash block
         if conc < srv.slots:
             col.emit(
                 SRV002,
                 path,
                 f"serving kv_blocks {srv.kv_blocks} holds only {conc} "
-                f"concurrent max-length sequence(s) ({per_seq} blocks "
-                f"each + the reserved trash block) but slots declares "
+                f"concurrent max-length sequence(s) ({per_seq_eff} "
+                "blocks each"
+                + (
+                    f" after the prefix_hit_rate {hit:g} sharing "
+                    f"discount of {shared} block(s)"
+                    if shared
+                    else ""
+                )
+                + " + the reserved trash block) but slots declares "
                 f"{srv.slots} decode lanes: the declared concurrency is "
                 "statically unreachable — admissions backpressure at "
                 f"{conc} live sequence(s)",
-                fix_hint=f"set kv_blocks >= {srv.slots * per_seq + 1} "
-                "(dense-equivalent), lower slots, or enable "
-                "prefix_cache to share blocks",
+                fix_hint=f"set kv_blocks >= "
+                f"{srv.slots * per_seq_eff + 1} (dense-equivalent), "
+                "lower slots, or enable prefix_cache to share blocks",
             )
     budget = cluster_cfg.device_hbm_bytes if cluster_cfg is not None else 0
     if budget > 0:
@@ -669,6 +708,7 @@ def fleet_cost_rules(
         else schema.ServingConfig.FIELDS["max_prefill_chunk"].default
     )
     rps, ticks = load.requests_per_s, load.ticks_per_s
+    hit = _declared_hit_rate(model_cfg)
     for role, n_hosts, per_tick, demand_tokens, knob in (
         ("decode", n_decode, slots, load.decode_tokens, "slots"),
         ("prefill", n_prefill, chunk, load.prompt_tokens,
@@ -678,6 +718,15 @@ def fleet_cost_rules(
             continue
         capacity = n_hosts * per_tick * ticks
         demand = rps * demand_tokens
+        discounted = False
+        if role == "prefill" and hit > 0:
+            # prefix-cache discount: a hit admission skips the prefill
+            # chunks its cached blocks cover, so at the declared
+            # fleet { load { prefix_hit_rate } } only (1 - rate) of
+            # the prompt tokens reach the prefill tier. Decode demand
+            # is untouched — every token still decodes
+            demand *= 1.0 - hit
+            discounted = True
         if demand > capacity:
             col.emit(
                 FLT002,
@@ -687,6 +736,11 @@ def fleet_cost_rules(
                 f"{ticks:g} ticks/s) is below the offered load "
                 f"{demand:.0f} tokens/s ({rps:g} req/s x "
                 f"{demand_tokens} {role} tokens"
+                + (
+                    f" x (1 - prefix_hit_rate {hit:g})"
+                    if discounted
+                    else ""
+                )
                 + (
                     "; unified hosts counted toward both roles"
                     if "unified" in roles

@@ -69,7 +69,8 @@ CMM001 = rule(
 SRV001 = rule(
     "SRV001",
     ERROR,
-    "prefix_cache enabled but kv_blocks cannot hold one max-length prompt",
+    "prefix_cache enabled but kv_blocks cannot hold one max-length "
+    "prompt, or tail_stride does not tile kv_block_len",
 )
 FLT001 = rule(
     "FLT001",
@@ -108,7 +109,8 @@ WIR001 = rule(
     ERROR,
     "socket transport misconfigured: missing/duplicate peer or "
     "frontdoor addresses, non-positive wire timeouts/backoff, or a "
-    "send deadline that cannot cover one max-size migration message",
+    "send deadline that cannot cover one max-size migration message "
+    "(or, with the prefix cache on, one max-prefix cache_ship frame)",
 )
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
@@ -495,6 +497,24 @@ def serving_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
     srv = getattr(model_cfg, "serving", None)
     if srv is None or srv.prefix_cache is None or not srv.prefix_cache.enabled:
         return
+    # partial-tail stride must tile the block: sub-block digests are
+    # registered at multiples of tail_stride inside one block, so a
+    # stride that does not divide kv_block_len (or is negative) is
+    # rejected by PrefixCache at engine construction — say it before
+    # any pod time is burned
+    stride = getattr(srv.prefix_cache, "tail_stride", 0)
+    block_len = max(1, srv.kv_block_len)
+    if stride < 0 or (stride and block_len % stride):
+        col.emit(
+            SRV001,
+            path,
+            f"serving.prefix_cache.tail_stride {stride} does not tile "
+            f"kv_block_len {block_len}: sub-block tail digests land at "
+            "multiples of the stride inside one block, so the engine "
+            "rejects this geometry at construction",
+            fix_hint=f"pick a positive tail_stride dividing "
+            f"{block_len} (or 0 to disable partial-tail sharing)",
+        )
     if srv.kv_blocks <= 0:
         return  # dense-equivalent sizing always fits one sequence
     window = _declared_window(model_cfg)
@@ -830,6 +850,39 @@ def wire_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
             "false peer-death tombstone",
             fix_hint=f"set wire.send_timeout_s >= {need_s:.2f} or "
             "declare the real link bandwidth",
+        )
+    # (d) the same budget for the fleet prefix cache's cache_ship
+    # frame: a max-depth ship carries every block of a max-length
+    # prompt's K/V (no token lane — digests ride in the JSON header).
+    # A too-short deadline here is WORSE than a failed migration: the
+    # requester holds the request until its fetch deadline, then
+    # degrades to plain prefill — every warm admission pays the fetch
+    # timeout and the cache never helps. Gated on the prefix cache
+    # actually being on (no cache, no ship frames)
+    pc = getattr(srv, "prefix_cache", None)
+    if pc is None or not getattr(pc, "enabled", False):
+        return
+    ship_bytes = (
+        2 * n_layers * heads * n_blocks * block_len * head_dim * 4
+        + n_blocks * 32  # hex digest chain in the JSON header
+        + 4096  # npz/header overhead
+    )
+    ship_need_s = ship_bytes / bw
+    if timeout < ship_need_s:
+        col.emit(
+            WIR001,
+            path,
+            f"wire.send_timeout_s {timeout:g} cannot cover one "
+            f"max-prefix cache_ship frame: ~{ship_bytes} bytes "
+            f"({n_layers} layers x {heads} heads x {n_blocks} blocks "
+            f"x {block_len} x {head_dim} K+V f32) at "
+            f"link_bandwidth_bytes_per_s {bw:g} needs "
+            f"~{ship_need_s:.2f}s per attempt — every cross-host "
+            "prefix fetch would burn its deadline and degrade to "
+            "plain prefill, so the fleet cache never helps",
+            fix_hint=f"set wire.send_timeout_s >= {ship_need_s:.2f}, "
+            "declare the real link bandwidth, or disable "
+            "serving.prefix_cache",
         )
 
 

@@ -102,6 +102,23 @@ class EngineConfig:
     #: instead of freeing them at retirement; False = share only among
     #: concurrently-live sequences
     prefix_lru: bool = True
+    #: ``prefix_cache { tail_stride }``: > 0 indexes each prompt's last
+    #: PARTIAL block at this sub-block token stride, so a prompt whose
+    #: shared prefix ends mid-block COW-extends the deepest partial
+    #: match instead of re-prefilling the whole block; must divide
+    #: kv_block_len. 0 = full-block granularity only.
+    prefix_tail_stride: int = 0
+    #: ``prefix_cache { decode_blocks }``: register FULL decode-written
+    #: blocks under the chained digest at retirement so multi-turn
+    #: traffic hits its own history. Warm streams over these blocks are
+    #: TOKEN-LEVEL identical to cold admission, not bitwise (the PR 9
+    #: cross-shape caveat: decode/verify writes ride a different
+    #: compiled shape than prefill).
+    prefix_decode_blocks: bool = False
+    #: ``prefix_cache { fetch_timeout_s }``: fleet hosts hold a request
+    #: awaiting a peer's cache_ship this long before degrading to plain
+    #: prefill (serve/fleet/host.py)
+    prefix_fetch_timeout_s: float = 2.0
     #: ``kernels { paged_attention }``: "reference" = the gather +
     #: cache_attend oracle path (bitwise-pinned, the default); "fused"
     #: = the Pallas kernel reading K/V blocks in place via the block
@@ -136,6 +153,13 @@ class EngineConfig:
             spec_drafter=spec.drafter if spec is not None else "ngram",
             prefix_cache=pc.enabled if pc is not None else False,
             prefix_lru=pc.lru if pc is not None else True,
+            prefix_tail_stride=pc.tail_stride if pc is not None else 0,
+            prefix_decode_blocks=(
+                pc.decode_blocks if pc is not None else False
+            ),
+            prefix_fetch_timeout_s=(
+                pc.fetch_timeout_s if pc is not None else 2.0
+            ),
             **kw,
         )
 
@@ -153,6 +177,11 @@ class Admission:
     cached_tokens: int = 0
     prefill_from: int = 0
     cow_copied: bool = False
+    #: tokens of ``cached_tokens`` served by COW-EXTENDING a registered
+    #: partial tail (sub-block sharing: the deepest matched tail block
+    #: was copied to a private fresh block and prefill starts past the
+    #: covered tokens); 0 = the hit ended on a block boundary
+    tail_tokens: int = 0
 
 
 class Engine:
@@ -196,6 +225,7 @@ class Engine:
             self.pool,
             prefix_cache=self.serving.prefix_cache,
             lru=self.serving.prefix_lru,
+            tail_stride=self.serving.prefix_tail_stride,
         )
         self.params = params
         s, mb = self.serving.slots, self.pool.max_blocks_per_seq
@@ -253,6 +283,16 @@ class Engine:
         # traced, so every migration reuses ONE compiled program each
         self._export_jit = jax.jit(self._export_prog)
         self._import_jit = jax.jit(self._import_prog, donate_argnums=(0,))
+        # fleet prefix shipping (serve/fleet/host.py): one fixed-shape
+        # gather of arbitrary registered blocks for a cache_ship reply,
+        # one fixed-shape scatter installing shipped bytes WITHOUT
+        # touching any lane (the warmed blocks belong to the cache, not
+        # to a slot) — rows are traced, so every ship reuses ONE
+        # compiled program each
+        self._export_blocks_jit = jax.jit(self._export_blocks_prog)
+        self._install_jit = jax.jit(
+            self._install_prog, donate_argnums=(0,)
+        )
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -670,6 +710,33 @@ class Engine:
             "live": state["live"].at[slot].set(True),
         }
 
+    def _export_blocks_prog(self, state, row):
+        """Gather an arbitrary block list's per-layer K/V into ONE
+        (L, MB, H, BL, D) bulk value — the device half of serving a
+        ``cache_fetch`` (pad rows gather the trash block; the host
+        trims them before the ship frame is serialized)."""
+        k = jnp.stack([kp[row] for kp in state["k"]])
+        v = jnp.stack([vp[row] for vp in state["v"]])
+        return k, v
+
+    def _install_prog(self, state, scatter_row, kblk, vblk):
+        """Scatter shipped (L, MB, H, BL, D) K/V bytes into freshly
+        allocated blocks — the same one-compiled-scatter discipline as
+        ``_import_prog`` minus the lane install: shipped prefix blocks
+        warm the CACHE, no slot goes live. Pad rows route to the trash
+        block."""
+        return {
+            **state,
+            "k": tuple(
+                kp.at[scatter_row].set(kblk[i])
+                for i, kp in enumerate(state["k"])
+            ),
+            "v": tuple(
+                vp.at[scatter_row].set(vblk[i])
+                for i, vp in enumerate(state["v"])
+            ),
+        }
+
     def _cow_prog(self, state, src, dst):
         """Copy block ``src``'s K/V to block ``dst`` in every layer —
         the copy-on-write a whole-prompt prefix hit needs before its
@@ -706,7 +773,16 @@ class Engine:
         (one fixed-shape compiled copy) and ``prefill_from`` points at
         the last prompt token — one 1-token chunk re-derives the
         activation logits, writing bitwise the bytes the shared source
-        already holds, into the private copy only."""
+        already holds, into the private copy only.
+
+        With ``prefix_cache { tail_stride }`` on, a hit whose last
+        shared tokens end MID-block COW-EXTENDS the deepest registered
+        partial tail: the tail block is copied into this sequence's
+        fresh block at the next chain position (same fixed-shape
+        compiled copy) and prefill starts past the covered tokens —
+        the copied positions are prefill-written bytes under the
+        identical left context, so they are bitwise what this
+        sequence's own cold prefill would write."""
         needed = self.pool.blocks_for(n_total_tokens)
         alloc = self.allocator
         hit: list[int] = []
@@ -718,14 +794,31 @@ class Engine:
             hit = alloc.cache.match_chain(chain)
         cached = len(hit) * self.pool.block_len
         cow = bool(hit) and cached >= len(prompt)
+        tail_src = tail_tokens = 0
+        if (
+            not cow
+            and alloc.cache is not None
+            and prompt is not None
+            and alloc.cache.tail_stride
+        ):
+            tail_src, tail_tokens = alloc.cache.match_tail(
+                prompt, len(hit), chain
+            )
+            cached += tail_tokens
         fresh_n = needed - len(hit) + (1 if cow else 0)
-        if fresh_n > alloc.headroom_excluding(hit):
+        protect = hit + ([tail_src] if tail_tokens else [])
+        if fresh_n > alloc.headroom_excluding(protect):
             raise PoolExhausted(
                 f"need {fresh_n} fresh blocks beyond a {len(hit)}-block "
-                f"prefix hit, {alloc.headroom_excluding(hit)} allocatable"
+                f"prefix hit, {alloc.headroom_excluding(protect)} "
+                "allocatable"
             )
         if hit:
             alloc.retain(hit)
+        if tail_tokens:
+            # pin the tail source across alloc(): a fresh allocation may
+            # otherwise LRU-reclaim the very block we are about to copy
+            alloc.retain([tail_src])
         fresh = alloc.alloc(fresh_n)
         if cow:
             # the whole prompt is cached: COW the last matched block so
@@ -737,6 +830,16 @@ class Engine:
                 self.state, jnp.int32(src), jnp.int32(dst)
             )
             alloc.release([src])
+        elif tail_tokens:
+            # partial-tail hit: copy the matched tail block into this
+            # sequence's own block at the next chain position; bytes
+            # beyond the covered tokens are re-prefilled or causally
+            # masked, so only the covered prefix is ever observed
+            blocks = hit + fresh
+            self.state = self._cow_jit(
+                self.state, jnp.int32(tail_src), jnp.int32(fresh[0])
+            )
+            alloc.release([tail_src])
         else:
             blocks = hit + fresh
         row = np.zeros((self.pool.max_blocks_per_seq,), np.int32)
@@ -752,6 +855,7 @@ class Engine:
             prefill_from=min(cached, max(len(prompt), 1) - 1)
             if prompt is not None else 0,
             cow_copied=cow,
+            tail_tokens=tail_tokens,
         )
 
     def register_prefix(self, slot: int, prompt) -> int:
@@ -772,6 +876,44 @@ class Engine:
         chain = self._slot_chain.get(slot) or cache.chain(prompt)
         new = 0
         for i, digest in enumerate(chain):
+            if not cache.has(digest):
+                new += cache.register(
+                    digest, blocks[i],
+                    parent=chain[i - 1] if i else None,
+                )
+        # partial-tail index: the prompt's LAST, partial block (if this
+        # sequence owns one) registers at every covered stride multiple
+        nb = len(chain)
+        if cache.tail_stride and len(blocks) > nb:
+            cache.register_tail(prompt, blocks[nb])
+        return new
+
+    def register_history(self, slot: int, tokens) -> int:
+        """Index ``slot``'s FULL blocks under the chained digests of
+        ``tokens`` — the whole prompt + emitted history, called at
+        retirement with ``prefix_cache { decode_blocks }`` on, so a
+        follow-up turn whose prompt replays this conversation hits the
+        decode-written blocks too. Digests over the prompt prefix are
+        identical to register_prefix()'s (chains are prefix-stable) and
+        skip as already-present; the NEW registrations cover
+        decode/verify-written bytes, which ride a different compiled
+        shape than prefill — a warm stream over them is TOKEN-LEVEL
+        identical to cold admission, not bitwise (the PR 9 cross-shape
+        caveat). Only blocks every position of which was actually
+        WRITTEN register: the last emitted token's K/V never is (a
+        token's cache entry is written by the tick that processes it,
+        which a finished stream never runs), so the chain clips to
+        ``len(tokens) - 1`` positions. -> newly registered blocks."""
+        cache = self.allocator.cache
+        if cache is None:
+            return 0
+        blocks = self._slot_blocks.get(slot)
+        if not blocks:
+            return 0
+        safe = (len(tokens) - 1) // self.pool.block_len
+        chain = cache.chain(tokens)[:safe]
+        new = 0
+        for i, digest in enumerate(chain[: len(blocks)]):
             if not cache.has(digest):
                 new += cache.register(
                     digest, blocks[i],
@@ -925,6 +1067,87 @@ class Engine:
             "shared": len(hit),
             "registered": registered,
         }
+
+    def export_blocks(self, blocks: list[int]) -> tuple:
+        """Gather arbitrary registered blocks' per-layer K/V as host
+        arrays ``(k, v)`` shaped (L, n, H, BL, D) — the byte payload of
+        a ``cache_ship`` reply. The caller retains the blocks across
+        the gather (an unlucky concurrent admission could otherwise
+        LRU-reclaim them mid-read)."""
+        n = len(blocks)
+        mb = self.pool.max_blocks_per_seq
+        if n > mb:
+            raise ValueError(
+                f"export_blocks of {n} blocks exceeds the "
+                f"{mb}-block fixed gather shape"
+            )
+        row = np.zeros((mb,), np.int32)
+        row[:n] = blocks
+        k, v = self._export_blocks_jit(self.state, jnp.asarray(row))
+        return np.asarray(k)[:, :n], np.asarray(v)[:, :n]
+
+    def install_prefix(self, chain: list[bytes], k, v) -> dict:
+        """Warm this pool with a peer's shipped prefix: allocate fresh
+        blocks for every chain position not already cached locally,
+        scatter the shipped per-layer K/V bytes into them (one compiled
+        dispatch, no lane touched), register them under the shipped
+        digests, and PARK them on the LRU — the next admission matching
+        this chain shares them exactly as if they had been prefilled
+        here. Feasibility is checked before any state is touched:
+        a backpressured install raises PoolExhausted as a true no-op
+        (the fleet host degrades the request to plain prefill). ->
+        {"installed", "shared"} block counts. Idempotent: re-delivering
+        the same ship installs nothing."""
+        alloc = self.allocator
+        if alloc.cache is None or not alloc.lru_enabled:
+            # without LRU parking a refcount-0 block cannot outlive the
+            # install call — nothing to warm (the host only fetches
+            # when prefix_lru is on)
+            return {"installed": 0, "shared": 0}
+        n = len(chain)
+        mb = self.pool.max_blocks_per_seq
+        if n > mb or int(k.shape[1]) != n:
+            raise ValueError(
+                f"install_prefix: {n} digests vs {int(k.shape[1])} "
+                f"shipped blocks (table width {mb})"
+            )
+        have = alloc.cache.match_chain(chain)
+        todo = n - len(have)
+        if todo == 0:
+            return {"installed": 0, "shared": n}
+        if todo > alloc.headroom_excluding(have):
+            raise PoolExhausted(
+                f"install needs {todo} fresh blocks beyond a "
+                f"{len(have)}-block local prefix, "
+                f"{alloc.headroom_excluding(have)} allocatable"
+            )
+        # pin the locally-matched parents across alloc(): evicting one
+        # would orphan the chain we are about to extend
+        if have:
+            alloc.retain(have)
+        fresh = alloc.alloc(todo)
+        scatter_row = np.zeros((mb,), np.int32)
+        scatter_row[:todo] = fresh
+        shape = (self.cfg.n_layers, mb) + tuple(k.shape[2:])
+        kblk = np.zeros(shape, k.dtype)
+        vblk = np.zeros(shape, v.dtype)
+        kblk[:, :todo] = k[:, len(have):]
+        vblk[:, :todo] = v[:, len(have):]
+        self.state = self._install_jit(
+            self.state, jnp.asarray(scatter_row),
+            jnp.asarray(kblk), jnp.asarray(vblk),
+        )
+        for i in range(len(have), n):
+            alloc.cache.register(
+                chain[i], fresh[i - len(have)],
+                parent=chain[i - 1] if i else None,
+            )
+        # the warmed blocks belong to no sequence: release parks them
+        # (registered, refcount 0) on the LRU for future admissions
+        alloc.release(fresh)
+        if have:
+            alloc.release(have)
+        return {"installed": todo, "shared": len(have)}
 
     def retire(self, slot: int) -> None:
         """Release the slot's blocks (refcount decrement: shared prefix

@@ -55,6 +55,7 @@ from .router import (
     DECODE_CAPABLE,
     MAX_PUBLISHED_DIGESTS,
     PREFILL_CAPABLE,
+    chain_coverage,
     decode_request,
     load_score,
 )
@@ -180,6 +181,25 @@ class FleetHost:
         self.migrate_out = 0
         self.blocks_in = 0
         self.blocks_out = 0
+        #: fleet prefix cache: requests held out of admission while a
+        #: peer's cache_ship is in flight — rid -> (request, monotonic
+        #: deadline, peer, first uncovered digest). Deadline expiry (or
+        #: the peer's tombstone) degrades to plain prefill; a held
+        #: request is never dropped and never hangs.
+        self._awaiting: dict[
+            int, tuple[Request, float, str, bytes]
+        ] = {}
+        #: one fetch attempt per request, ever — a miss after a ship
+        #: (or a degrade) must not re-fetch in a loop
+        self._fetch_tried: set[int] = set()
+        self.cache_fetches = 0
+        self.cache_fetch_timeouts = 0
+        self.cache_ships_in = 0
+        self.cache_ships_out = 0
+        self.ship_blocks_in = 0
+        self.ship_blocks_out = 0
+        self.ship_bytes_in = 0
+        self.ship_bytes_out = 0
         transport.register(name)
         # run-start provenance: which role this rank serves — the
         # cross-rank merge keys its per-host rows on this event
@@ -198,7 +218,9 @@ class FleetHost:
 
     @property
     def busy(self) -> bool:
-        return bool(self.sched.busy or self._pending)
+        return bool(
+            self.sched.busy or self._pending or self._awaiting
+        )
 
     def _peer_snapshots(self, roles, exclude: str | None = None):
         """Published statuses of capable peers, least-loaded first;
@@ -356,6 +378,8 @@ class FleetHost:
         role), publish fresh status. -> tokens emitted."""
         self._recv()
         self._note_peer_deaths()
+        self._expire_fetches()
+        self._maybe_fetch()
         self._import_pending()
         emitted = self.sched.tick()
         if self.role == "prefill":
@@ -395,6 +419,10 @@ class FleetHost:
                 self._pending.append(
                     (migrate.deserialize(msg.payload), msg.src)
                 )
+            elif msg.kind == "cache_fetch":
+                self._serve_fetch(msg)
+            elif msg.kind == "cache_ship":
+                self._install_ship(msg)
             elif msg.kind == "shutdown":
                 self._shutdown = True
 
@@ -450,6 +478,214 @@ class FleetHost:
                 registered=info["registered"],
                 tokens_done=len(req.tokens),
             )
+
+    # -- fleet prefix cache (cache_fetch / cache_ship) ------------------
+
+    def _maybe_fetch(self) -> None:
+        """For each NEW queued request whose prompt chain a peer's
+        published digests cover deeper than our own cache, send ONE
+        ``cache_fetch`` and hold the request out of admission until
+        the ship lands (or the deadline passes — degrade to plain
+        prefill, never a hang). One attempt per request, ever. Any
+        peer role qualifies as a source: decode hosts hold migrated
+        and decode-registered history too."""
+        cache = self.engine.allocator.cache
+        if (
+            cache is None
+            or not self.engine.serving.prefix_lru
+            or not self.peers
+            or not self.sched._queue
+        ):
+            return
+        snaps = [
+            s for s in self.transport.statuses().values()
+            if s.get("host") in self.peers
+            and s.get("host") not in self._dead
+            and s.get("role") in ROLES
+            and s.get("cached_digests")
+        ]
+        if not snaps:
+            return
+        timeout = self.engine.serving.prefix_fetch_timeout_s
+        inflight = {head for _, _, _, head in self._awaiting.values()}
+        for req in list(self.sched._queue):
+            if req.rid in self._fetch_tried:
+                continue
+            chain = cache.chain(req.prompt)
+            if not chain:
+                self._fetch_tried.add(req.rid)
+                continue
+            local = len(cache.match_chain(chain))
+            if local >= len(chain):
+                self._fetch_tried.add(req.rid)
+                continue
+            if chain[local] in inflight:
+                # a ship covering this request's first uncovered block
+                # is already in flight (the shared-prefix workload:
+                # every queued request misses on the SAME prefix) — do
+                # not multiply the wire traffic, but DO hold the
+                # request: admitted now it would prefill cold and
+                # register the very blocks the ship carries, wasting
+                # both. The landing ship releases every held request
+                # it covers (or the deadline degrades them)
+                self._fetch_tried.add(req.rid)
+                kept = [r for r in self.sched._queue if r is not req]
+                self.sched._queue.clear()
+                self.sched._queue.extend(kept)
+                self._awaiting[req.rid] = (
+                    req, time.monotonic() + timeout, "", chain[local],
+                )
+                continue
+            self._fetch_tried.add(req.rid)
+            hex_chain = [d.hex() for d in chain]
+            best, best_n = None, local
+            for s in snaps:
+                n = chain_coverage(hex_chain, s)
+                if n > best_n:
+                    best, best_n = s.get("host"), n
+            if best is None:
+                continue
+            try:
+                self.transport.send(
+                    best, "cache_fetch",
+                    migrate.serialize_fetch(req.rid, chain),
+                    src=self.name,
+                )
+            except WireError as e:
+                self._mark_dead(best, str(e))
+                continue
+            # hold the request aside (identity filter: Request's
+            # dataclass == would compare prompt arrays); it re-enters
+            # via submit() when the ship lands or the deadline passes
+            kept = [r for r in self.sched._queue if r is not req]
+            self.sched._queue.clear()
+            self.sched._queue.extend(kept)
+            self._awaiting[req.rid] = (
+                req, time.monotonic() + timeout, best, chain[local],
+            )
+            inflight.add(chain[local])
+            self.cache_fetches += 1
+            self._event(
+                "cache_fetch", rid=req.rid, peer=best,
+                blocks=len(chain), local_blocks=local,
+                peer_blocks=best_n,
+            )
+
+    def _expire_fetches(self) -> None:
+        """Degrade every held request whose ship deadline passed (or
+        whose source peer died) to plain prefill — backpressure on the
+        fetch path must never strand a request."""
+        if not self._awaiting:
+            return
+        now = time.monotonic()
+        for rid in list(self._awaiting):
+            req, deadline, peer, _head = self._awaiting[rid]
+            if now < deadline and peer not in self._dead:
+                continue
+            del self._awaiting[rid]
+            self.cache_fetch_timeouts += 1
+            self._event("cache_fetch_timeout", rid=rid, peer=peer)
+            self.sched.submit(req)
+
+    def _serve_fetch(self, msg) -> None:
+        """Answer a peer's ``cache_fetch`` with ONE ``cache_ship``
+        bulk frame: our longest cached prefix of its digest chain,
+        blocks retained across the compiled gather so a concurrent
+        admission cannot reclaim them mid-read. An empty match still
+        ships (zero blocks): the requester degrades immediately
+        instead of waiting out its deadline on our stale
+        advertisement."""
+        try:
+            rid, chain = migrate.deserialize_fetch(msg.payload)
+        except ValueError as e:
+            self.log(f"fleet host {self.name}: bad cache_fetch from "
+                     f"{msg.src!r}: {e}")
+            return
+        cache = self.engine.allocator.cache
+        blocks: list[int] = []
+        if cache is not None:
+            blocks = cache.match_chain(chain)[
+                : self.engine.pool.max_blocks_per_seq
+            ]
+        if blocks:
+            self.engine.allocator.retain(blocks)
+            try:
+                k, v = self.engine.export_blocks(blocks)
+            finally:
+                self.engine.allocator.release(blocks)
+        else:
+            shape = (
+                self.engine.cfg.n_layers, 0, self.engine.cfg.n_heads,
+                self.engine.pool.block_len, self.engine.cfg.head_dim,
+            )
+            k = np.zeros(shape, np.float32)
+            v = np.zeros(shape, np.float32)
+        data = migrate.serialize_ship(rid, chain[: len(blocks)], k, v)
+        try:
+            self.transport.send(msg.src, "cache_ship", data,
+                                src=self.name)
+        except WireError as e:
+            self._mark_dead(msg.src, str(e))
+            return
+        self.cache_ships_out += 1
+        self.ship_blocks_out += len(blocks)
+        self.ship_bytes_out += len(data)
+        self._event(
+            "cache_ship", rid=rid, peer=msg.src, dir="out",
+            blocks=len(blocks), bytes=len(data),
+        )
+
+    def _install_ship(self, msg) -> None:
+        """Install a peer's ``cache_ship`` into our pool (scatter +
+        register + LRU-park, engine.install_prefix) and release the
+        held request back into admission — where it now hits locally,
+        sharing the installed blocks exactly like home-grown ones. A
+        backpressured (or empty, or duplicate) ship still releases
+        the request: worst case is plain prefill."""
+        waiting = None
+        try:
+            ship = migrate.deserialize_ship(msg.payload)
+        except ValueError as e:
+            self.log(f"fleet host {self.name}: bad cache_ship from "
+                     f"{msg.src!r}: {e}")
+            return
+        waiting = self._awaiting.pop(ship["rid"], None)
+        installed = shared = 0
+        if ship["chain"]:
+            try:
+                info = self.engine.install_prefix(
+                    ship["chain"], ship["k"], ship["v"]
+                )
+                installed = info["installed"]
+                shared = info["shared"]
+            except PoolExhausted:
+                self._event(
+                    "backpressure",
+                    queued=len(self.sched._queue),
+                    free_blocks=self.engine.allocator.free_blocks,
+                    site="cache_ship",
+                )
+        self.cache_ships_in += 1
+        self.ship_blocks_in += installed
+        self.ship_bytes_in += len(msg.payload)
+        self._event(
+            "cache_ship", rid=ship["rid"], peer=msg.src, dir="in",
+            blocks=installed, shared=shared, bytes=len(msg.payload),
+            cached_tokens=int(
+                (installed + shared) * self.engine.pool.block_len
+            ),
+        )
+        # release the ship's own request AND every piggybacked hold
+        # whose first uncovered block the installed chain covers — they
+        # re-enter admission and hit the freshly registered blocks
+        covered = set(ship["chain"])
+        for rid in list(self._awaiting):
+            held, _deadline, _peer, head = self._awaiting[rid]
+            if head in covered:
+                del self._awaiting[rid]
+                self.sched.submit(held)
+        if waiting is not None:
+            self.sched.submit(waiting[0])
 
     def _export_ready(self) -> None:
         """Ship every filled (decoding-status) sequence to a decode
@@ -520,7 +756,8 @@ class FleetHost:
             "free_slots": self.engine.serving.slots
             - len(self.sched._slot_req),
             "kv_blocks_free": self.engine.allocator.free_blocks,
-            "queue_depth": len(self.sched._queue) + len(self._pending),
+            "queue_depth": len(self.sched._queue) + len(self._pending)
+            + len(self._awaiting),
             "live": len(self.sched._slot_req),
         }
         cache = self.engine.allocator.cache
@@ -615,7 +852,12 @@ class FleetHost:
             for m, _ in self._pending
         ]
         self._pending.clear()
-        for req in list(self.sched._queue) + pending_reqs:
+        # requests held for an in-flight cache_ship forward like any
+        # queued request — the warm blocks were an optimization, the
+        # request itself must leave with the drain
+        awaiting_reqs = [v[0] for v in self._awaiting.values()]
+        self._awaiting.clear()
+        for req in list(self.sched._queue) + pending_reqs + awaiting_reqs:
             from .router import encode_request
 
             dst = self._send_with_failover(

@@ -31,6 +31,16 @@ import shares the matched blocks instead of re-writing them, and
 newly imported full-prompt blocks join the importer's index — cross-
 host cache reuse for the price of a list of digests on the wire.
 
+The FLEET PREFIX CACHE rides the same one-shot discipline without a
+sequence attached: a host whose admission misses locally but whose
+peers' published digest chains cover the prompt sends ``cache_fetch``
+(the prompt's digest chain, JSON) and receives ONE ``cache_ship``
+bulk frame — the matched blocks' per-layer K/V bytes plus their
+digests — which it scatters into fresh blocks and registers
+(engine.install_prefix). Warm KV now moves over ANY transport
+(in-process, mailbox, TCP wire); no shared filesystem is assumed
+anywhere, and a host that never hears back degrades to plain prefill.
+
 Serialization is numpy's npz container (every array in one buffer)
 plus a JSON metadata record — self-describing, versioned, no pickle.
 """
@@ -46,6 +56,9 @@ import numpy as np
 
 #: wire-format tag; bump on any incompatible layout change
 MIGRATE_FORMAT = "singa-tpu-migrate-v1"
+#: fleet prefix-cache frames (cache_fetch request / cache_ship reply)
+FETCH_FORMAT = "singa-tpu-cachefetch-v1"
+SHIP_FORMAT = "singa-tpu-cacheship-v1"
 
 
 @dataclasses.dataclass
@@ -173,3 +186,73 @@ def deserialize(data: bytes) -> MigratedSequence:
                 if meta.get("clock") == os.getpid() else 0.0
             ),
         )
+
+
+# ---------------------------------------------------------------------------
+# fleet prefix-cache frames
+# ---------------------------------------------------------------------------
+
+
+def serialize_fetch(rid: int, chain: list[bytes]) -> bytes:
+    """A ``cache_fetch``: the requesting host's prompt digest chain
+    (prefix-ordered). The peer matches its longest cached prefix and
+    replies with ONE ``cache_ship``; digests are tiny, so this frame
+    is JSON."""
+    return json.dumps(
+        {"format": FETCH_FORMAT, "rid": int(rid),
+         "chain": [d.hex() for d in chain]}
+    ).encode("utf-8")
+
+
+def deserialize_fetch(data: bytes) -> tuple[int, list[bytes]]:
+    """bytes -> (rid, digest chain); raises ValueError on a foreign
+    format."""
+    meta = json.loads(data.decode("utf-8"))
+    if meta.get("format") != FETCH_FORMAT:
+        raise ValueError(
+            f"cache_fetch format {meta.get('format')!r} != "
+            f"{FETCH_FORMAT!r}"
+        )
+    return int(meta["rid"]), [bytes.fromhex(h) for h in meta["chain"]]
+
+
+def serialize_ship(rid: int, chain: list[bytes], k, v) -> bytes:
+    """A ``cache_ship``: the matched prefix's digests plus its blocks'
+    per-layer K/V bytes — ``k``/``v`` shaped (L, n, H, BL, D) from
+    ``engine.export_blocks`` — as one bulk npz frame. ``n`` may be 0
+    (the peer's advertisement was stale): an empty ship tells the
+    requester to degrade to plain prefill immediately instead of
+    waiting out its deadline."""
+    meta = {
+        "format": SHIP_FORMAT,
+        "rid": int(rid),
+        "chain": [d.hex() for d in chain],
+    }
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        ),
+        k=np.asarray(k),
+        v=np.asarray(v),
+    )
+    return buf.getvalue()
+
+
+def deserialize_ship(data: bytes) -> dict:
+    """bytes -> {"rid", "chain", "k", "v"}; raises ValueError on a
+    foreign format (a fleet must not silently mis-scatter)."""
+    with np.load(io.BytesIO(data)) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        if meta.get("format") != SHIP_FORMAT:
+            raise ValueError(
+                f"cache_ship format {meta.get('format')!r} != "
+                f"{SHIP_FORMAT!r}"
+            )
+        return {
+            "rid": int(meta["rid"]),
+            "chain": [bytes.fromhex(h) for h in meta["chain"]],
+            "k": z["k"],
+            "v": z["v"],
+        }

@@ -59,6 +59,21 @@ def load_score(status: dict) -> tuple:
     )
 
 
+def chain_coverage(chain_hex: list[str], status: dict) -> int:
+    """How many leading digests of a prompt's hex chain a host's
+    published snapshot covers. The router's affinity policy ranks
+    prefill-capable hosts by it; a fleet host ranks ALL peers by it to
+    pick a ``cache_fetch`` target (any role may hold warm history —
+    decode hosts register migrated and decode-written blocks too)."""
+    cached = set(status.get("cached_digests") or ())
+    n = 0
+    for d in chain_hex:
+        if d not in cached:
+            break
+        n += 1
+    return n
+
+
 class Router:
     """Placement policy over published host statuses. The router holds
     NO host references — it reads snapshots from the transport's
@@ -100,12 +115,7 @@ class Router:
             return None, 0
         best, best_n = None, 0
         for s in snapshots:  # already least-loaded-sorted: ties break
-            cached = set(s.get("cached_digests") or ())
-            n = 0
-            for d in chain:
-                if d not in cached:
-                    break
-                n += 1
+            n = chain_coverage(chain, s)
             if n > best_n:
                 best, best_n = s.get("host"), n
         return best, best_n

@@ -28,10 +28,13 @@ the two deterministic drill wirings):
 
 Message kinds (``Message.kind``): ``migrate`` (a serialized
 MigratedSequence), ``request`` (a JSON-encoded generation request),
-``result`` (a JSON-encoded finished stream), ``shutdown`` (empty
-payload), ``status`` is NOT a message — it rides the latest-wins
-``publish``/``statuses`` side channel so a slow consumer never backs
-up the feedback loop.
+``result`` (a JSON-encoded finished stream), ``cache_fetch`` (a
+JSON-encoded prefix-digest chain a host wants a peer's warm blocks
+for) and its bulk reply ``cache_ship`` (the matched blocks' per-layer
+K/V bytes as ONE frame — the fleet prefix cache,
+serve/fleet/migrate.py), ``shutdown`` (empty payload). ``status`` is
+NOT a message — it rides the latest-wins ``publish``/``statuses``
+side channel so a slow consumer never backs up the feedback loop.
 """
 
 from __future__ import annotations
@@ -46,7 +49,10 @@ import time
 from ...resilience.coord import atomic_write_bytes
 
 #: message kinds the fleet speaks
-KINDS = ("migrate", "request", "result", "shutdown")
+KINDS = (
+    "migrate", "request", "result", "shutdown",
+    "cache_fetch", "cache_ship",
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -138,15 +138,40 @@ class PrefixCache:
     their whole left context matches, which (with prefill's bitwise
     split-invariance) makes their pool bytes interchangeable too. Only
     blocks every position of which was prefill-written from PROMPT
-    tokens are registered: decode/verify-written entries ride
-    different compiled shapes (the PR 9 cross-shape caveat), so caching
-    them would trade the bitwise-identical-to-cold guarantee for a
-    token-level one. The index maps digest -> block id; membership is
-    what the allocator's release path consults to route a refcount-0
-    block to the LRU list instead of the free list."""
+    tokens are registered in the FULL-block index by default:
+    decode/verify-written entries ride different compiled shapes (the
+    PR 9 cross-shape caveat), so caching them trades the
+    bitwise-identical-to-cold guarantee for a token-level one — the
+    engine only does so behind ``prefix_cache { decode_blocks }``. The
+    index maps digest -> block id; membership is what the allocator's
+    release path consults to route a refcount-0 block to the LRU list
+    instead of the free list.
 
-    def __init__(self, block_len: int):
+    PARTIAL TAILS (``tail_stride`` > 0): a prompt's LAST, partial block
+    additionally registers sub-block digests at every ``tail_stride``
+    tokens — ``t_j = H_tail(parent_full_digest, tokens[h*BL : h*BL +
+    j*S])`` with a domain-separated hash, mapping to ``(block,
+    tokens_covered)``. A later prompt whose shared prefix ends
+    mid-block matches the DEEPEST registered tail and COW-extends it
+    (the engine copies the tail block to a private fresh block and
+    prefills only past the covered tokens). Soundness: the covered
+    positions were prompt-prefill-written under the identical left
+    context, so — by prefill's bitwise split-invariance — the copied
+    bytes are bit-for-bit what the new sequence's own cold prefill
+    would have written; bytes BEYOND the covered tokens in the copy are
+    either re-prefilled by the new sequence or causally masked, so they
+    never move an output bit. The stride must divide ``block_len``
+    (netlint SRV001 mirrors this check statically)."""
+
+    def __init__(self, block_len: int, tail_stride: int = 0):
+        if tail_stride < 0 or (tail_stride and block_len % tail_stride):
+            raise ValueError(
+                f"prefix_cache.tail_stride {tail_stride} must divide "
+                f"kv_block_len {block_len} (sub-block digests index "
+                "whole stride multiples)"
+            )
         self.block_len = block_len
+        self.tail_stride = tail_stride
         #: bumped on every index mutation (register/forget) — cheap
         #: change detection for consumers that derive state from the
         #: index (the fleet host's published digest feedback)
@@ -159,6 +184,16 @@ class PrefixCache:
         #: must cascade or descendants sit indexed-but-unreachable
         self._parent: dict[bytes, bytes | None] = {}
         self._children: dict[bytes, set[bytes]] = {}
+        #: partial-tail index: tail digest -> (block, tokens covered);
+        #: one block registers a tail at EVERY stride multiple its
+        #: prompt coverage reaches, so the deepest match wins
+        self._tail_block: dict[bytes, tuple[int, int]] = {}
+        self._tails_of: dict[int, set[bytes]] = {}
+        #: tail digests are only matchable under their parent FULL
+        #: digest's chain (parent b"" = chain head), so evicting the
+        #: parent must cascade them out exactly like full children
+        self._tail_parent: dict[bytes, bytes] = {}
+        self._tail_children: dict[bytes, set[bytes]] = {}
 
     def __len__(self) -> int:
         return len(self._by_digest)
@@ -166,6 +201,15 @@ class PrefixCache:
     @staticmethod
     def _digest(prev: bytes, token_bytes: bytes) -> bytes:
         h = hashlib.blake2b(prev, digest_size=16)
+        h.update(token_bytes)
+        return h.digest()
+
+    @staticmethod
+    def _tail_digest(parent: bytes, token_bytes: bytes) -> bytes:
+        # blake2b personalization domain-separates sub-block tail
+        # digests from the full-block chain, so a tail can never
+        # collide into (or be matched as) a full block
+        h = hashlib.blake2b(parent, digest_size=16, person=b"tail")
         h.update(token_bytes)
         return h.digest()
 
@@ -209,7 +253,79 @@ class PrefixCache:
         return out if limit is None else out[:limit]
 
     def is_cached(self, block: int) -> bool:
-        return block in self._digest_of
+        return block in self._digest_of or block in self._tails_of
+
+    def match_tail(self, tokens, matched_blocks: int,
+                   chain: list[bytes]) -> tuple[int, int]:
+        """Deepest registered partial-tail extension of an
+        ``matched_blocks``-deep full-block match of ``tokens`` ->
+        ``(block, tokens_covered)``, or ``(0, 0)`` (block 0 is the
+        reserved trash block, never a tail). Probes every stride
+        multiple the prompt still covers past the matched blocks."""
+        if not self.tail_stride or not self._tail_block:
+            return 0, 0
+        h, bl = matched_blocks, self.block_len
+        rem = min(len(tokens) - h * bl, bl)
+        if rem < self.tail_stride:
+            return 0, 0
+        parent = chain[h - 1] if h else b""
+        buf = np.ascontiguousarray(tokens, dtype="<i4").tobytes()
+        base = 4 * h * bl
+        best = (0, 0)
+        for j in range(self.tail_stride, min(rem, bl - 1) + 1,
+                       self.tail_stride):
+            entry = self._tail_block.get(
+                self._tail_digest(parent, buf[base:base + 4 * j])
+            )
+            if entry is not None:
+                best = entry
+        return best
+
+    def register_tail(self, tokens, block: int) -> int:
+        """Index ``block`` — the prompt's LAST, partial block — under
+        sub-block digests at every stride multiple its prompt coverage
+        reaches (``tokens`` = the WHOLE prompt; the tail starts at the
+        last full-block boundary). First writer wins per depth. -> how
+        many depths were newly registered."""
+        if not self.tail_stride:
+            return 0
+        bl = self.block_len
+        nb = len(tokens) // bl
+        rem = len(tokens) - nb * bl
+        if rem < self.tail_stride:
+            return 0
+        parent = self.chain(tokens)[nb - 1] if nb else b""
+        buf = np.ascontiguousarray(tokens, dtype="<i4").tobytes()
+        base = 4 * nb * bl
+        new = 0
+        for j in range(self.tail_stride, rem + 1, self.tail_stride):
+            d = self._tail_digest(parent, buf[base:base + 4 * j])
+            if d in self._tail_block:
+                continue
+            self._tail_block[d] = (block, j)
+            self._tails_of.setdefault(block, set()).add(d)
+            self._tail_parent[d] = parent
+            self._tail_children.setdefault(parent, set()).add(d)
+            new += 1
+        if new:
+            self.version += 1
+        return new
+
+    def _drop_tail(self, d: bytes) -> int:
+        """Remove one tail entry -> its block id."""
+        block, _ = self._tail_block.pop(d)
+        parent = self._tail_parent.pop(d)
+        kids = self._tail_children.get(parent)
+        if kids is not None:
+            kids.discard(d)
+            if not kids:
+                del self._tail_children[parent]
+        tails = self._tails_of.get(block)
+        if tails is not None:
+            tails.discard(d)
+            if not tails:
+                del self._tails_of[block]
+        return block
 
     def register(self, digest: bytes, block: int,
                  parent: bytes | None = None) -> bool:
@@ -234,12 +350,22 @@ class PrefixCache:
         leaving it indexed would strand it unmatchable forever while
         still counting as cached. -> every block whose entry was
         removed (the allocator returns the LRU-parked ones to the free
-        list); empty for an unregistered block."""
+        list); empty for an unregistered block. Partial-tail entries
+        cascade with it: tails OF this block (and of any removed
+        descendant), and tails PARENTED on any removed digest — a tail
+        is only matchable through its parent's chain position."""
         d = self._digest_of.get(block)
-        if d is None:
+        had_tails = block in self._tails_of
+        if d is None and not had_tails:
             return []
         self.version += 1
+        if had_tails:
+            for td in list(self._tails_of[block]):
+                self._drop_tail(td)
+        if d is None:
+            return [block]
         removed: list[int] = []
+        tail_orphans: set[int] = set()
         stack = [d]
         while stack:
             dig = stack.pop()
@@ -248,12 +374,19 @@ class PrefixCache:
                 continue
             del self._digest_of[b]
             removed.append(b)
+            for td in list(self._tails_of.get(b, ())):
+                self._drop_tail(td)
+            for td in list(self._tail_children.get(dig, ())):
+                tail_orphans.add(self._drop_tail(td))
             parent = self._parent.pop(dig, None)
             if parent is not None and parent in self._children:
                 self._children[parent].discard(dig)
                 if not self._children[parent]:
                     del self._children[parent]
             stack.extend(self._children.pop(dig, ()))
+        for b in tail_orphans:
+            if b != block and not self.is_cached(b) and b not in removed:
+                removed.append(b)
         return removed
 
 
@@ -270,7 +403,7 @@ class BlockAllocator:
     corrupt the free list (the latent pre-refcount hazard)."""
 
     def __init__(self, pool: KVPool, *, prefix_cache: bool = False,
-                 lru: bool = True):
+                 lru: bool = True, tail_stride: int = 0):
         self.pool = pool
         self._free = list(range(pool.n_blocks - 1, 0, -1))  # pop() -> 1,2,..
         self._ref: dict[int, int] = {}
@@ -279,7 +412,8 @@ class BlockAllocator:
             collections.OrderedDict()
         )
         self.cache: PrefixCache | None = (
-            PrefixCache(pool.block_len) if prefix_cache else None
+            PrefixCache(pool.block_len, tail_stride) if prefix_cache
+            else None
         )
         self.lru_enabled = lru
         #: optional lifecycle sink: callable(kind, **payload) — the

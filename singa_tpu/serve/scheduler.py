@@ -121,6 +121,13 @@ class Scheduler:
         self.prefix_hits = 0
         self.blocks_shared = 0
         self.cow_copies = 0
+        #: hits whose shared prefix ended MID-block (a registered
+        #: partial tail was COW-extended) and the tail tokens they saved
+        self.partial_hits = 0
+        self.tail_tokens_shared = 0
+        #: full decode-written blocks indexed at retirement
+        #: (``prefix_cache { decode_blocks }``)
+        self.decode_blocks_registered = 0
         self.prefill_chunks = 0
         self.prefill_chunks_saved = 0
         # allocator lifecycle (lru_evict/lru_reclaim) rides the same
@@ -162,6 +169,8 @@ class Scheduler:
         self.spec_drafted = self.spec_accepted = 0
         self.prefix_lookups = self.prefix_hits = 0
         self.blocks_shared = self.cow_copies = 0
+        self.partial_hits = self.tail_tokens_shared = 0
+        self.decode_blocks_registered = 0
         self.prefill_chunks = self.prefill_chunks_saved = 0
         self.engine.allocator.reset_stats()
         self._live_ticks = 0
@@ -262,6 +271,14 @@ class Scheduler:
                     cached_tokens=int(adm.cached_tokens),
                     blocks_shared=int(shared), chunks_saved=int(saved),
                 )
+            if adm.tail_tokens:
+                self.partial_hits += 1
+                self.tail_tokens_shared += adm.tail_tokens
+                self._event(
+                    "partial_hit", rid=req.rid, slot=slot,
+                    cached_tokens=int(adm.cached_tokens),
+                    tail_tokens=int(adm.tail_tokens),
+                )
             if adm.cow_copied:
                 self.cow_copies += 1
                 self._event("cow_copy", rid=req.rid, slot=slot)
@@ -317,6 +334,28 @@ class Scheduler:
         return False
 
     def _finish(self, slot: int, req: Request, reason: str) -> None:
+        if (
+            self.engine.serving.prefix_decode_blocks
+            and self.engine.allocator.cache is not None
+            and req.tokens
+        ):
+            # multi-turn reuse: index the conversation's FULL blocks —
+            # decode-written ones included — before the release below
+            # parks them, so a follow-up prompt replaying this history
+            # hits it (token-level parity: the PR 9 cross-shape caveat)
+            n = self.engine.register_history(
+                slot,
+                np.concatenate(
+                    [np.asarray(req.prompt, np.int32),
+                     np.asarray(req.tokens, np.int32)]
+                ),
+            )
+            if n:
+                self.decode_blocks_registered += n
+                self._event(
+                    "decode_register", rid=req.rid, slot=slot,
+                    blocks=int(n),
+                )
         self.engine.retire(slot)
         del self._slot_req[slot]
         req.status = "done"
@@ -517,6 +556,9 @@ class Scheduler:
             )
             out["blocks_shared"] = self.blocks_shared
             out["cow_copies"] = self.cow_copies
+            out["partial_hits"] = self.partial_hits
+            out["tail_tokens_shared"] = self.tail_tokens_shared
+            out["decode_blocks_registered"] = self.decode_blocks_registered
             out["prefill_chunks"] = self.prefill_chunks
             out["prefill_chunks_saved"] = self.prefill_chunks_saved
             out["lru_evictions"] = alloc.lru_evictions

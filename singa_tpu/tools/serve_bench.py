@@ -743,14 +743,204 @@ def run_fleet(params, cfg, prompts, args, *, recorders=None,
     return hosts, router, elapsed, streams, lat_ms, acct, wire
 
 
+def _fleet_prefix_main(args, params, cfg, prompts) -> int:
+    """The --fleet shared_prefix drill: the FLEET prefix cache. Two
+    unified hosts on the in-process transport. The COLD phase serves
+    every request on one fresh host with the cache OFF; the WARM phase
+    first serves the whole workload on the OTHER host (parking its
+    blocks on that host's LRU), then serves the measured requests on a
+    host that has never seen the prompts — its only path to warm KV is
+    a cross-host cache_fetch -> cache_ship over the wire. Or-gate (the
+    CPU-CI pattern): warm end-to-end tokens/sec >=
+    --prefix_threshold x cold, OR executed prefill chunks drop >= 2x
+    (deterministic). Streams must match cold bitwise and >= 1 block
+    must actually ship either way."""
+    import copy
+
+    import numpy as np
+
+    from ..serve import Request
+
+    def drive(hosts):
+        idle = 0
+        for _ in range(10 ** 5):
+            for h in hosts:
+                h.tick()
+            # an in-flight fetch/ship sits in the transport for one
+            # round; only consecutive idle rounds mean the fleet ran dry
+            idle = idle + 1 if not any(h.busy for h in hosts) else 0
+            if idle >= 3:
+                return
+        raise RuntimeError("fleet prefix drill stalled")
+
+    def submit_wave(host, rid0):
+        for i, pr in enumerate(prompts):
+            host.submit(Request(
+                rid=rid0 + i, prompt=np.asarray(pr, np.int32),
+                max_new_tokens=args.max_new, seed=args.seed + i,
+            ))
+
+    def reset(hosts):
+        for h in hosts:
+            h.sched.finished.clear()
+            h.sched.reset_counters()
+            h.cache_fetches = h.cache_fetch_timeouts = 0
+            h.cache_ships_in = h.cache_ships_out = 0
+            h.ship_blocks_in = h.ship_blocks_out = 0
+            h.ship_bytes_in = h.ship_bytes_out = 0
+
+    # COLD: cache off, the whole workload on ONE host (its peer idles
+    # — the same per-host compute the warm phase's serving host gets)
+    cargs = copy.copy(args)
+    cargs.fleet_hosts = "unified,unified"
+    cargs.prefix_cache = False
+    cold_hosts, _, _ = build_fleet(params, cfg, cargs)
+    # compile-warm off the clock, then zero the counters
+    cold_hosts[1].submit(Request(
+        rid=-1, prompt=np.asarray(prompts[0]), max_new_tokens=2,
+    ))
+    drive(cold_hosts)
+    reset(cold_hosts)
+    t0 = time.perf_counter()
+    submit_wave(cold_hosts[1], 0)
+    drive(cold_hosts)
+    cold_s = time.perf_counter() - t0
+    cold = {
+        r.rid: list(r.tokens)
+        for h in cold_hosts for r in h.sched.finished if r.rid >= 0
+    }
+    cold_chunks = sum(h.sched.prefill_chunks for h in cold_hosts)
+    cold_tokens = sum(len(t) for t in cold.values())
+
+    # WARM: cache on. The warm wave runs the SAME workload on host0,
+    # parking every prompt's blocks (the shared prefix AND the unique
+    # tails) on ITS LRU; host1 compile-warms on a DISJOINT prompt
+    # (sharing the prefix here would register it locally and bypass
+    # the wire entirely), so its measured admissions can only go warm
+    # through cache_fetch -> cache_ship.
+    wargs = copy.copy(args)
+    wargs.fleet_hosts = "unified,unified"
+    wargs.prefix_cache = True
+    warm_hosts, _, _ = build_fleet(params, cfg, wargs)
+    h0, h1 = warm_hosts
+    rs = np.random.RandomState(args.seed + 997)
+    h1.submit(Request(
+        rid=-1,
+        prompt=rs.randint(
+            0, args.vocab, size=(args.prompt_len,)
+        ).astype(np.int32),
+        max_new_tokens=2,
+    ))
+    h0.submit(Request(
+        rid=-2, prompt=np.asarray(prompts[0]), max_new_tokens=2,
+    ))
+    drive(warm_hosts)
+    submit_wave(h0, 10 ** 6)  # the warm wave (uncounted)
+    drive(warm_hosts)
+    reset(warm_hosts)
+    recorders = None
+    if args.workspace:
+        import os
+
+        from ..obs.recorder import FlightRecorder
+
+        events = os.path.join(args.workspace, "events")
+        recorders = [
+            FlightRecorder(events, rank=i, run_id="serve_bench_fleetprefix")
+            for i in range(len(warm_hosts))
+        ]
+        for h, rec in zip(warm_hosts, recorders):
+            h.sched.recorder = rec
+            h._event("fleet_role", host=h.name, role=h.role)
+    t0 = time.perf_counter()
+    submit_wave(h1, 0)
+    drive(warm_hosts)
+    warm_s = time.perf_counter() - t0
+    warm = {
+        r.rid: list(r.tokens)
+        for h in warm_hosts for r in h.sched.finished if r.rid >= 0
+    }
+    warm_chunks = sum(h.sched.prefill_chunks for h in warm_hosts)
+    warm_tokens = sum(len(t) for t in warm.values())
+
+    mismatches = sum(1 for i in cold if warm.get(i) != cold[i])
+    blocks_shipped = sum(h.ship_blocks_in for h in warm_hosts)
+    ship_bytes = sum(h.ship_bytes_in for h in warm_hosts)
+    admitted = len(warm) or 1
+    hits = sum(h.sched.prefix_hits for h in warm_hosts)
+    out = {
+        "fleet": True,
+        "workload": "shared_prefix",
+        "fleet_hosts": "unified,unified",
+        "requests": len(prompts),
+        "finished": len(warm),
+        "tokens": warm_tokens,
+        "cold_tokens": cold_tokens,
+        "serve_s": round(warm_s, 4),
+        "cold_s": round(cold_s, 4),
+        "tokens_per_s": round(warm_tokens / warm_s, 1)
+        if warm_s > 0 else 0.0,
+        "cold_tokens_per_s": round(cold_tokens / cold_s, 1)
+        if cold_s > 0 else 0.0,
+        "hit_rate": round(hits / admitted, 4),
+        "cache_fetches": sum(h.cache_fetches for h in warm_hosts),
+        "cache_fetch_timeouts": sum(
+            h.cache_fetch_timeouts for h in warm_hosts
+        ),
+        "blocks_shipped": blocks_shipped,
+        "ship_bytes": ship_bytes,
+        "prefill_chunks": warm_chunks,
+        "cold_prefill_chunks": cold_chunks,
+        "prefill_chunk_ratio": round(cold_chunks / warm_chunks, 3)
+        if warm_chunks else None,
+        "token_mismatches": mismatches,
+        "prefix_threshold": args.prefix_threshold,
+        "transport": "local",
+    }
+    out["fleet_speedup"] = (
+        round(out["tokens_per_s"] / out["cold_tokens_per_s"], 3)
+        if out["cold_tokens_per_s"] else None
+    )
+    # or-gate: end-to-end carries on accelerator hosts; on CPU CI the
+    # deterministic arm carries (warm admissions EXECUTED >= 2x fewer
+    # prefill chunks than cold). Streams must match and >= 1 block
+    # must have moved over the wire either way.
+    out["pass_mode"] = (
+        "end_to_end"
+        if (out["fleet_speedup"] or 0) >= args.prefix_threshold
+        else "chunk_drop"
+        if (out["prefill_chunk_ratio"] or 0) >= 2.0
+        else None
+    )
+    out["pass"] = (
+        mismatches == 0 and blocks_shipped >= 1
+        and out["pass_mode"] is not None
+    )
+    if recorders:
+        for i, rec in enumerate(recorders):
+            rec.event(
+                "run_stop", step=warm_hosts[i].sched.ticks, exit_code=0,
+            )
+            rec.close()
+    print(json.dumps(out))
+    if args.no_gate:
+        return 0
+    return 0 if out["pass"] else 1
+
+
 def _fleet_main(args, params, cfg, prompts) -> int:
     """The --fleet drill: role-split hosts behind the front-door
     router vs ONE unified host at the same per-host slots (which is
     also the token oracle — scheduling, routing, and migration may
     never move a token). Reports per-host occupancy + queue-inclusive
     p50/p99; with --sigterm_at_tick/--sigterm_host, the drain-to-peer
-    drill (exit 75, streams still identical)."""
+    drill (exit 75, streams still identical). ``--workload
+    shared_prefix`` dispatches to the fleet prefix-cache drill
+    (_fleet_prefix_main) instead."""
     from ..resilience.preemption import EXIT_RESUMABLE
+
+    if args.workload == "shared_prefix" and not args.sigterm_at_tick:
+        return _fleet_prefix_main(args, params, cfg, prompts)
 
     n_hosts = len([r for r in args.fleet_hosts.split(",") if r.strip()])
     recorders = router_rec = None

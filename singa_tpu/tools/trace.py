@@ -304,6 +304,23 @@ def summarize(records: list[dict]) -> dict:
         int(d.get("chunks_saved", 0)) for d in prefix_hit_events
     )
 
+    # fleet prefix cache: cache_fetch -> cache_ship round trips
+    # (counted on the receiver, dir="in", where the bytes landed —
+    # dir="out" double-counts the same frame on the sender), partial-
+    # tail hits, decode-written block registrations
+    ships_in = [
+        r["data"]
+        for r in life
+        if r.get("kind") == "cache_ship"
+        and isinstance(r.get("data"), dict)
+        and r["data"].get("dir") == "in"
+    ]
+    partial_hit_events = [
+        r["data"]
+        for r in life
+        if r.get("kind") == "partial_hit" and isinstance(r.get("data"), dict)
+    ]
+
     # fleet: per-host roles from the run-start fleet_role events, and
     # block-migration volume from migrate_in (counted on the importer,
     # where the blocks actually landed; migrate_out double-counts a
@@ -322,6 +339,7 @@ def summarize(records: list[dict]) -> dict:
     hosts: dict[str, dict] = {}
     if fleet_roles:
         per_rank: dict[int, dict[str, int]] = {}
+        per_rank_cache: dict[int, dict[str, int]] = {}
         for r in life:
             rank = int(r.get("rank", 0))
             if rank not in fleet_roles:
@@ -329,17 +347,54 @@ def summarize(records: list[dict]) -> dict:
             per_rank.setdefault(rank, {})
             k = r.get("kind", "?")
             per_rank[rank][k] = per_rank[rank].get(k, 0) + 1
+            d = r.get("data") if isinstance(r.get("data"), dict) else None
+            if d is None:
+                continue
+            acc = per_rank_cache.setdefault(rank, {})
+            if k == "prefix_hit":
+                acc["chunks_saved"] = (
+                    acc.get("chunks_saved", 0)
+                    + int(d.get("chunks_saved", 0))
+                )
+            elif k == "cache_ship":
+                way = "in" if d.get("dir") == "in" else "out"
+                acc[f"ships_{way}"] = acc.get(f"ships_{way}", 0) + 1
+                acc[f"ship_bytes_{way}"] = (
+                    acc.get(f"ship_bytes_{way}", 0) + int(d.get("bytes", 0))
+                )
+                acc[f"ship_blocks_{way}"] = (
+                    acc.get(f"ship_blocks_{way}", 0)
+                    + int(d.get("blocks", 0))
+                )
         for rank in sorted(fleet_roles):
             c = per_rank.get(rank, {})
+            cc = per_rank_cache.get(rank, {})
+            admitted = c.get("request_admit", 0)
             hosts[str(rank)] = {
                 "role": fleet_roles[rank],
-                "admitted": c.get("request_admit", 0),
+                "admitted": admitted,
                 "prefill_chunks": c.get("prefill", 0),
                 "migrate_in": c.get("migrate_in", 0),
                 "migrate_out": c.get("migrate_out", 0),
                 "retired": c.get("retire", 0),
                 "evicted": c.get("evict", 0),
                 "drains": c.get("drain", 0),
+                # fleet prefix cache, this host's view: hit rate over
+                # its admissions, chunks its hits skipped, fetch/ship
+                # traffic in both directions
+                "prefix_hits": c.get("prefix_hit", 0),
+                "prefix_hit_rate": (
+                    round(c.get("prefix_hit", 0) / admitted, 4)
+                    if admitted else None
+                ),
+                "partial_hits": c.get("partial_hit", 0),
+                "chunks_saved": cc.get("chunks_saved", 0),
+                "cache_fetches": c.get("cache_fetch", 0),
+                "cache_fetch_timeouts": c.get("cache_fetch_timeout", 0),
+                "cache_ships_in": cc.get("ships_in", 0),
+                "cache_ships_out": cc.get("ships_out", 0),
+                "ship_bytes_in": cc.get("ship_bytes_in", 0),
+                "ship_bytes_out": cc.get("ship_bytes_out", 0),
             }
 
     # wire transport (comm/wire.py): connect/retry/timeout/redeliver
@@ -507,6 +562,32 @@ def summarize(records: list[dict]) -> dict:
             "migrations": len(migrate_in_events),
             "migrated_blocks": migrated_blocks,
             "routed": counts.get("route", 0),
+            # fleet prefix cache (None = no fetch/ship/partial events
+            # in this log): cross-host warm-KV traffic counted on the
+            # receiving side, partial-tail sharing, decode-written
+            # block registrations
+            "fleet_cache": {
+                "fetches": counts.get("cache_fetch", 0),
+                "fetch_timeouts": counts.get("cache_fetch_timeout", 0),
+                "ships": len(ships_in),
+                "blocks_shipped": sum(
+                    int(d.get("blocks", 0)) for d in ships_in
+                ),
+                "ship_bytes": sum(
+                    int(d.get("bytes", 0)) for d in ships_in
+                ),
+                "partial_hits": len(partial_hit_events),
+                "tail_tokens_shared": sum(
+                    int(d.get("tail_tokens", 0))
+                    for d in partial_hit_events
+                ),
+                "decode_registers": counts.get("decode_register", 0),
+            }
+            if (
+                counts.get("cache_fetch") or ships_in
+                or partial_hit_events or counts.get("decode_register")
+            )
+            else None,
             "hosts": hosts or None,
         }
         if (

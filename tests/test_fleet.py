@@ -1125,14 +1125,23 @@ def test_trace_summarize_fleet_section(tmp_path):
     assert s["migrations"] == 1
     assert s["migrated_blocks"] == 3
     assert s["routed"] == 1
+    cache_zero = {
+        "prefix_hits": 0, "partial_hits": 0, "chunks_saved": 0,
+        "cache_fetches": 0, "cache_fetch_timeouts": 0,
+        "cache_ships_in": 0, "cache_ships_out": 0,
+        "ship_bytes_in": 0, "ship_bytes_out": 0,
+    }
     assert s["hosts"] == {
         "0": {"role": "prefill", "admitted": 1, "prefill_chunks": 1,
               "migrate_in": 0, "migrate_out": 1, "retired": 0,
-              "evicted": 0, "drains": 0},
+              "evicted": 0, "drains": 0, "prefix_hit_rate": 0.0,
+              **cache_zero},
         "1": {"role": "decode", "admitted": 0, "prefill_chunks": 0,
               "migrate_in": 1, "migrate_out": 0, "retired": 1,
-              "evicted": 0, "drains": 0},
+              "evicted": 0, "drains": 0, "prefix_hit_rate": None,
+              **cache_zero},
     }
+    assert s["fleet_cache"] is None
 
 
 @pytest.mark.slow

@@ -1,0 +1,606 @@
+"""Fleet-wide prefix cache (ISSUE 19): cross-host block-byte
+shipping (``cache_fetch`` -> ``cache_ship``), partial-tail sharing at
+``tail_stride`` granularity, and decode-written block registration —
+plus the lint satellites (SRV001 stride arm, WIR001 cache-ship
+deadline arm, the SRV002/FLT002 declared-hit-rate discounts).
+
+The correctness bar is the prefix-cache parity discipline extended
+across the wire: a warm stream — whether its blocks were grown
+locally, COW-extended from a partial tail, registered at decode
+retirement, or scattered in from a peer's ship frame — is BITWISE
+the cold stream. Shipped bytes may only skip prefill work, never
+move a token; and a fetch that gets no answer degrades to plain
+prefill, never a hang.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from singa_tpu.config import parse_model_config
+from singa_tpu.lint import Collector
+from singa_tpu.lint.cost_model import fleet_cost_rules, serving_cost_rules
+from singa_tpu.lint.net_rules import lint_model_text
+from singa_tpu.models.transformer import TransformerConfig, init_lm
+from singa_tpu.serve import Engine, EngineConfig, Request, Scheduler
+from singa_tpu.serve.fleet import FleetHost, LocalTransport, migrate
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_len=32
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def tiny_params(cfg, seed=0):
+    return init_lm(jax.random.PRNGKey(seed), cfg)
+
+
+class _Recorder:
+    """Event sink with the recorder's .event() shape."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **payload):
+        self.events.append((kind, payload))
+
+    def record_span(self, *a, **kw):
+        pass
+
+
+def serve_seq(engine, prompts, budgets, *, slots_serial=True,
+              recorder=None):
+    """Serve with slots=1 semantics (FIFO, retire-before-admit) so
+    every request sees the previous ones' registered blocks."""
+    sched = Scheduler(engine, recorder=recorder)
+    for i, (p, m) in enumerate(zip(prompts, budgets)):
+        sched.submit(Request(rid=i, prompt=np.asarray(p, np.int32),
+                             max_new_tokens=m))
+    sched.serve()
+    return sched
+
+
+def streams(sched):
+    return {r.rid: list(r.tokens) for r in sched.finished}
+
+
+def build_unified_pair(params, cfg, ec0, ec1=None):
+    t = LocalTransport()
+    h0 = FleetHost("h0", "unified", Engine(params, cfg, ec0), t,
+                   peers={"h1": "unified"})
+    h1 = FleetHost("h1", "unified", Engine(params, cfg, ec1 or ec0), t,
+                   peers={"h0": "unified"})
+    return h0, h1, t
+
+
+def drive(hosts, n_done, max_rounds=3000):
+    idle = 0
+    for _ in range(max_rounds):
+        for h in hosts:
+            h.tick()
+        done = sum(
+            1 for h in hosts for r in h.sched.finished if r.rid >= 0
+        )
+        if done >= n_done:
+            return
+        idle = idle + 1 if not any(h.busy for h in hosts) else 0
+        assert idle < 5, "fleet stalled with requests unfinished"
+    raise AssertionError("fleet did not finish in the round budget")
+
+
+# ---------------------------------------------------------------------------
+# partial-tail sharing: COW-extend identity sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stride", [2, 4])
+@pytest.mark.parametrize("fill", [0, 1])
+def test_partial_tail_cow_extend_identity_sweep(stride, fill):
+    """Prompts ending mid-block that share a sub-block prefix at
+    ``tail_stride`` granularity COW-extend the deepest cached partial
+    match — across strides and tail fill offsets, warm streams are
+    bitwise the cold ones and the partial hits actually happened."""
+    cfg = tiny_cfg()
+    params = tiny_params(cfg)
+    rs = np.random.RandomState(7 + stride + fill)
+    base = rs.randint(0, cfg.vocab, size=(8,)).astype(np.int32)  # 1 block
+    tail = rs.randint(0, cfg.vocab, size=(6,)).astype(np.int32)
+    # the seed prompt registers base's full block + tail sub-digests;
+    # followers share tail[:j] (j a stride multiple) then diverge,
+    # `fill` shifting how deep past the stride point they run
+    prompts = [np.concatenate([base, tail])]
+    for j in range(stride, len(tail), stride):
+        uniq = rs.randint(0, cfg.vocab, size=(1 + fill,)).astype(np.int32)
+        prompts.append(np.concatenate([base, tail[:j], uniq]))
+    budgets = [4] * len(prompts)
+
+    def run(enabled):
+        ec = EngineConfig(
+            slots=1, kv_block_len=8, max_prefill_chunk=8,
+            prefix_cache=enabled, prefix_tail_stride=stride,
+        )
+        return serve_seq(Engine(params, cfg, ec), prompts, budgets)
+
+    warm, cold = run(True), run(False)
+    assert streams(warm) == streams(cold)
+    assert warm.partial_hits == len(prompts) - 1, (
+        "every follower's tail should COW-extend a cached partial"
+    )
+    assert warm.tail_tokens_shared >= stride * (len(prompts) - 1)
+    assert warm.prefill_chunks <= cold.prefill_chunks
+
+
+# ---------------------------------------------------------------------------
+# decode-written block registration
+# ---------------------------------------------------------------------------
+
+
+def test_decode_block_registration_parity():
+    """With ``prefix_cache { decode_blocks }`` on, a retiring stream
+    registers its FULL decode-written blocks; a re-admission whose
+    prompt extends into that history hits them — token-level parity
+    with a cold engine, across retire and re-admit."""
+    cfg = tiny_cfg()
+    params = tiny_params(cfg)
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(0, cfg.vocab, size=(8,)).astype(np.int32)
+    ec = EngineConfig(
+        slots=1, kv_block_len=4, max_prefill_chunk=4,
+        prefix_cache=True, prefix_decode_blocks=True,
+    )
+    rec = _Recorder()
+    eng = Engine(params, cfg, ec)
+    first = serve_seq(eng, [prompt], [9], recorder=rec)
+    hist = list(first.finished[0].tokens)
+    regs = [p for k, p in rec.events if k == "decode_register"]
+    assert len(regs) == 1
+    reg = regs[0]
+    # prompt(8) + 9 emitted = 17 tokens; (17-1)//4 = 4 blocks held,
+    # 2 of them decode-written past the 2 prompt blocks
+    assert reg["blocks"] == 2
+
+    # re-admit a prompt that extends INTO the decoded history: the
+    # follower's prefix covers prompt blocks AND decode-written ones
+    follow = np.concatenate([prompt, np.asarray(hist[:8], np.int32)])
+    warm = serve_seq(eng, [follow], [4])
+    cold = serve_seq(
+        Engine(params, cfg, EngineConfig(
+            slots=1, kv_block_len=4, max_prefill_chunk=4,
+        )),
+        [follow], [4],
+    )
+    assert streams(warm) == streams(cold)
+    assert warm.prefix_hits == 1
+    assert warm.blocks_shared >= 3, (
+        "hit must cover decode-written blocks, not just the prompt's"
+    )
+
+
+# ---------------------------------------------------------------------------
+# cross-host block-byte shipping
+# ---------------------------------------------------------------------------
+
+
+FLEET_EC = dict(slots=2, kv_block_len=8, max_prefill_chunk=8,
+                prefix_cache=True)
+
+
+def test_cross_host_ship_bitwise_vs_local_hit():
+    """A host that has never seen the prompt fetches its peer's
+    blocks over the wire and streams BITWISE what a local hit (and a
+    cold engine) produces — the tentpole identity bar."""
+    cfg = tiny_cfg()
+    params = tiny_params(cfg)
+    prompt = (np.arange(22, dtype=np.int32) * 5) % cfg.vocab
+    n = 6
+    h0, h1, _ = build_unified_pair(params, cfg, EngineConfig(**FLEET_EC))
+    # warm h1 only; h0 sees the prompt first through the ship
+    h1.submit(Request(rid=0, prompt=prompt, max_new_tokens=n))
+    drive([h0, h1], 1)
+    assert h1.engine.allocator.cache.match(prompt), "h1 must be warm"
+    h0.submit(Request(rid=1, prompt=prompt, max_new_tokens=n))
+    drive([h0, h1], 2)
+    assert h0.cache_fetches == 1
+    assert h0.cache_ships_in == 1 and h1.cache_ships_out == 1
+    assert h0.ship_blocks_in == 2 == h1.ship_blocks_out
+    assert h0.ship_bytes_in == h1.ship_bytes_out > 0
+    assert h0.cache_fetch_timeouts == 0
+    assert h0.sched.prefix_hits == 1, "installed blocks must serve the hit"
+    shipped = next(r for r in h0.sched.finished if r.rid == 1)
+    warm_peer = next(r for r in h1.sched.finished if r.rid == 0)
+
+    # oracles: a local hit on a third engine, and a cold engine
+    local = Engine(params, cfg, EngineConfig(**FLEET_EC))
+    warm_local = serve_seq(local, [prompt, prompt], [n, n])
+    cold = serve_seq(
+        Engine(params, cfg, EngineConfig(
+            **{**FLEET_EC, "prefix_cache": False}
+        )),
+        [prompt], [n],
+    )
+    want = streams(cold)[0]
+    assert list(shipped.tokens) == want
+    assert list(warm_peer.tokens) == want
+    assert streams(warm_local)[0] == streams(warm_local)[1] == want
+
+
+def test_fetch_timeout_degrades_to_plain_prefill():
+    """A peer that advertises digests but never answers: the held
+    request degrades to plain prefill at the deadline — correct
+    stream, counted timeout, no ship, no hang."""
+    cfg = tiny_cfg()
+    params = tiny_params(cfg)
+    prompt = (np.arange(20, dtype=np.int32) * 3) % cfg.vocab
+    n = 5
+    ec_fast = EngineConfig(**FLEET_EC, prefix_fetch_timeout_s=0.02)
+    h0, h1, _ = build_unified_pair(
+        params, cfg, ec_fast, EngineConfig(**FLEET_EC)
+    )
+    h1.submit(Request(rid=0, prompt=prompt, max_new_tokens=n))
+    drive([h0, h1], 1)  # h1 warm, status (digests) published
+    h0.submit(Request(rid=1, prompt=prompt, max_new_tokens=n))
+    # tick ONLY h0: the fetch goes out but nothing ever answers
+    deadline = time.monotonic() + 10.0
+    while not any(r.rid == 1 for r in h0.sched.finished):
+        assert time.monotonic() < deadline, "degrade path hung"
+        h0.tick()
+        time.sleep(0.005)
+    assert h0.cache_fetches == 1
+    assert h0.cache_fetch_timeouts == 1
+    assert h0.cache_ships_in == 0 and h0.sched.prefix_hits == 0
+    cold = serve_seq(
+        Engine(params, cfg, EngineConfig(
+            **{**FLEET_EC, "prefix_cache": False}
+        )),
+        [prompt], [n],
+    )
+    got = next(r for r in h0.sched.finished if r.rid == 1)
+    assert list(got.tokens) == streams(cold)[0]
+
+
+def test_resent_ship_frame_is_idempotent():
+    """A duplicate ``cache_ship`` frame (retry after a lost ack, a
+    stale in-flight answer) installs NOTHING the second time: same
+    pool, same free-block count, and the prompt still streams
+    bitwise cold."""
+    cfg = tiny_cfg()
+    params = tiny_params(cfg)
+    prompt = (np.arange(22, dtype=np.int32) * 7) % cfg.vocab
+    n = 5
+    h0, h1, t = build_unified_pair(params, cfg, EngineConfig(**FLEET_EC))
+    h1.submit(Request(rid=0, prompt=prompt, max_new_tokens=n))
+    drive([h0, h1], 1)
+    # hand-build the exact frame h1 would ship, deliver it TWICE
+    cache = h1.engine.allocator.cache
+    chain = cache.chain(prompt)
+    blocks = cache.match_chain(chain)
+    assert len(blocks) == 2
+    h1.engine.allocator.retain(blocks)
+    k, v = h1.engine.export_blocks(blocks)
+    h1.engine.allocator.release(blocks)
+    data = migrate.serialize_ship(99, chain[: len(blocks)], k, v)
+    for _ in range(2):
+        t.send("h0", "cache_ship", data, src="h1")
+    h0.tick()
+    assert h0.cache_ships_in == 2
+    assert h0.ship_blocks_in == 2, (
+        "the duplicate frame must install zero new blocks"
+    )
+    free_after_dupe = h0.engine.allocator.free_blocks
+    # a third delivery is still a no-op on the pool
+    t.send("h0", "cache_ship", data, src="h1")
+    h0.tick()
+    assert h0.ship_blocks_in == 2
+    assert h0.engine.allocator.free_blocks == free_after_dupe
+    # and the installed-once blocks serve a bitwise-cold hit
+    h0.submit(Request(rid=1, prompt=prompt, max_new_tokens=n))
+    drive([h0, h1], 2)
+    assert h0.sched.prefix_hits == 1 and h0.cache_fetches == 0
+    cold = serve_seq(
+        Engine(params, cfg, EngineConfig(
+            **{**FLEET_EC, "prefix_cache": False}
+        )),
+        [prompt], [n],
+    )
+    got = next(r for r in h0.sched.finished if r.rid == 1)
+    assert list(got.tokens) == streams(cold)[0]
+
+
+# ---------------------------------------------------------------------------
+# the OS-process drill: two real processes, real TCP, DISTINCT workspaces
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_os_process_fleet_prefix_ship_distinct_workspaces(tmp_path):
+    """The no-shared-filesystem proof: two ``python -m singa_tpu.main``
+    unified hosts with DISTINCT workspaces (nothing on disk in common)
+    over real TCP. Host 0 serves a prompt cold; the SAME prompt sent
+    to host 1 rides a cross-host ``cache_ship`` — its K/V bytes cross
+    only the socket. Streams must be bitwise equal, and the merged
+    trace (one events dir per workspace) must reconstruct the fetch,
+    the out/in ship pair, and strictly fewer prefill chunks on the
+    warm host."""
+    from singa_tpu.comm.wire import SocketTransport, WireError
+    from singa_tpu.config import parse_model_config
+    from singa_tpu.serve.fleet.host import lm_config_from_conf
+    from singa_tpu.serve.fleet.router import encode_request
+    from singa_tpu.tools.trace import load_events, summarize
+
+    addr0 = f"127.0.0.1:{_free_port()}"
+    addr1 = f"127.0.0.1:{_free_port()}"
+    addr_fd = f"127.0.0.1:{_free_port()}"
+    conf = f"""
+name: "fleet-prefix-wire"
+neuralnet {{
+  layer {{ name: "embed" type: "kEmbedding"
+    embedding_param {{ vocab_size: 32 embedding_dim: 32 max_len: 32 }} }}
+  layer {{ name: "attn" type: "kAttention" srclayers: "embed"
+    attention_param {{ num_heads: 2 }} }}
+}}
+serving {{ slots: 2 kv_block_len: 8 max_prefill_chunk: 8
+  prefix_cache {{ enabled: true fetch_timeout_s: 10.0 }} }}
+fleet {{ transport: socket
+  peers {{ name: "host0" role: "unified" address: "{addr0}" }}
+  peers {{ name: "host1" role: "unified" address: "{addr1}" }}
+  wire {{ frontdoor_address: "{addr_fd}"
+         connect_timeout_s: 2.0 send_timeout_s: 10.0
+         max_retries: 6 backoff_s: 0.2 backoff_cap_s: 2.0 }}
+}}
+"""
+    model_conf = tmp_path / "fleet.conf"
+    model_conf.write_text(conf)
+    workspaces = []
+    cluster_confs = []
+    for k in range(2):
+        ws = tmp_path / f"ws{k}"  # DISTINCT per process
+        cc = tmp_path / f"cluster{k}.conf"
+        cc.write_text(
+            f'nworkers: 2\nnprocs_per_group: 1\nworkspace: "{ws}"\n'
+        )
+        workspaces.append(ws)
+        cluster_confs.append(cc)
+    cfg = lm_config_from_conf(parse_model_config(conf))
+    prompt = ((np.arange(22, dtype=np.int32) * 5) + 3) % cfg.vocab
+    n = 4
+
+    env = {
+        **os.environ, "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+    }
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "singa_tpu.main",
+             "-model_conf", str(model_conf),
+             "-cluster_conf", str(cluster_confs[k]),
+             "-procsID", str(k)],
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for k in range(2)
+    ]
+    driver = SocketTransport(
+        {"host0": addr0, "host1": addr1, "frontdoor": addr_fd},
+        connect_timeout_s=2.0, send_timeout_s=10.0, max_retries=2,
+        backoff_s=0.2, backoff_cap_s=1.0,
+    )
+    results = {}
+
+    def ask(host, rid, deadline):
+        payload = encode_request(
+            Request(rid=rid, prompt=prompt, max_new_tokens=n)
+        )
+        while True:  # the host may still be importing jax
+            try:
+                driver.send(host, "request", payload, src="frontdoor")
+                break
+            except WireError:
+                assert time.monotonic() < deadline, (
+                    f"{host} never came up",
+                    [p.poll() for p in procs],
+                )
+                time.sleep(1.0)
+        while rid not in results:
+            assert time.monotonic() < deadline, (
+                "no result", [p.poll() for p in procs],
+            )
+            for msg in driver.recv("frontdoor"):
+                if msg.kind == "result":
+                    d = json.loads(msg.payload.decode())
+                    results[d["rid"]] = d
+            time.sleep(0.05)
+
+    try:
+        driver.register("frontdoor")
+        deadline = time.monotonic() + 300
+        ask("host0", 0, deadline)
+        # let host0's retire-time digest publication reach host1
+        # before the warm request queues there
+        time.sleep(3.0)
+        ask("host1", 1, deadline)
+        for name in ("host0", "host1"):
+            driver.send(name, "shutdown", b"", src="frontdoor")
+        for p in procs:
+            assert p.wait(timeout=120) == 0, p.stdout.read().decode()
+    finally:
+        driver.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert results[0]["host"] == "host0"
+    assert results[1]["host"] == "host1"
+    assert results[0]["tokens"] == results[1]["tokens"], (
+        "shipped bytes moved a token"
+    )
+    recs = []
+    for ws in workspaces:
+        r, skipped = load_events(str(ws / "events"))
+        assert skipped == 0
+        recs.extend(r)
+    kinds = {}
+    for r in recs:
+        kinds.setdefault(r["kind"], []).append(r)
+    assert any(
+        (r.get("data") or {}).get("rid") == 1
+        for r in kinds.get("cache_fetch", [])
+    ), "host1 never fetched"
+    ships = kinds.get("cache_ship", [])
+    dirs = {(r.get("data") or {}).get("dir") for r in ships}
+    assert {"out", "in"} <= dirs, ships
+    ship_in = next(r for r in ships
+                   if (r.get("data") or {}).get("dir") == "in")
+    assert ship_in["data"]["blocks"] >= 1, ship_in
+    fc = summarize(recs)["serving"]["fleet_cache"]
+    assert fc["ships"] >= 1 and fc["blocks_shipped"] >= 1, fc
+    assert fc["fetch_timeouts"] == 0, fc
+    chunks = [0, 0]
+    for r in kinds.get("prefill", []):
+        chunks[(r.get("data") or {}).get("rid")] += 1
+    assert 0 < chunks[1] < chunks[0], (
+        "warm host must prefill strictly less than cold", chunks,
+    )
+
+
+# ---------------------------------------------------------------------------
+# lint satellites: SRV001 stride arm, WIR001 cache-ship deadline arm,
+# SRV002/FLT002 declared-hit-rate discounts
+# ---------------------------------------------------------------------------
+
+
+LINT_BASE = """
+name: "fleetprefix-lint"
+neuralnet {{
+  layer {{ name: "embed" type: "kEmbedding"
+    embedding_param {{ vocab_size: 32 embedding_dim: 32 max_len: 64 }} }}
+  layer {{ name: "attn" type: "kAttention" srclayers: "embed"
+    attention_param {{ num_heads: 2 }} }}
+}}
+serving {{ slots: 2 kv_block_len: 8 kv_blocks: 32 max_prefill_chunk: 8
+  prefix_cache {{ enabled: true tail_stride: {stride} }} }}
+"""
+
+
+def _lint(text):
+    col = Collector()
+    lint_model_text(text, "job.conf", col)
+    return [(d.code, d.msg) for d in col.sorted()]
+
+
+def test_srv001_tail_stride_must_tile_block():
+    bad = _lint(LINT_BASE.format(stride=3))
+    assert any(
+        c == "SRV001" and "tail_stride" in m for c, m in bad
+    ), bad
+    for ok_stride in (0, 4, 8):
+        ds = _lint(LINT_BASE.format(stride=ok_stride))
+        assert not [d for d in ds if d[0] == "SRV001"], (ok_stride, ds)
+
+
+WIRE_SHIP = """
+name: "wire-ship-lint"
+neuralnet {{
+  layer {{ name: "embed" type: "kEmbedding"
+    embedding_param {{ vocab_size: 32 embedding_dim: 32 max_len: 64 }} }}
+  layer {{ name: "attn" type: "kAttention" srclayers: "embed"
+    attention_param {{ num_heads: 2 }} }}
+}}
+serving {{ slots: 2 kv_block_len: 8 kv_blocks: 32 max_prefill_chunk: 8
+  prefix_cache {{ enabled: {enabled} }} }}
+fleet {{ transport: socket
+  peers {{ name: "p0" role: "prefill" address: "127.0.0.1:9001" }}
+  peers {{ name: "d0" role: "decode" address: "127.0.0.1:9002" }}
+  wire {{ frontdoor_address: "127.0.0.1:9100"
+    send_timeout_s: 0.001 link_bandwidth_bytes_per_s: 1000.0 }}
+}}
+"""
+
+
+def test_wir001_cache_ship_deadline_arm_gated_on_cache():
+    hot = _lint(WIRE_SHIP.format(enabled="true"))
+    assert any(
+        c == "WIR001" and "cache_ship frame" in m for c, m in hot
+    ), hot
+    off = _lint(WIRE_SHIP.format(enabled="false"))
+    assert not any("cache_ship" in m for _, m in off), off
+
+
+HITRATE_CONF = """
+name: "hitrate-lint"
+updater {{ base_learning_rate: 0.1 type: kSGD }}
+neuralnet {{
+  layer {{ name: "emb" type: "kEmbedding"
+    embedding_param {{ vocab_size: 64 embedding_dim: 32 max_len: 64 }} }}
+  layer {{ name: "att" type: "kAttention" srclayers: "emb"
+    attention_param {{ num_heads: 4 }} }}
+}}
+serving {{ slots: 8 kv_block_len: 16 kv_blocks: 9 max_prefill_chunk: 64
+  prefix_cache {{ enabled: true }} }}
+fleet {{
+  peers {{ name: "p0" role: prefill }}
+  peers {{ name: "d0" role: decode }}
+  load {{ requests_per_s: 5 prompt_tokens: 128 decode_tokens: 0
+         ticks_per_s: 5 {hit} }}
+}}
+"""
+
+
+def _codes(rules, cfg):
+    col = Collector()
+    if rules is fleet_cost_rules:
+        rules(cfg, None, "t.conf", col)
+    else:
+        rules(cfg, None, None, "t.conf", col)
+    return [(d.code, d.msg) for d in col.sorted()]
+
+
+def test_cost_rules_discount_by_declared_hit_rate():
+    """A declared ``fleet { load { prefix_hit_rate } }`` discounts
+    both static pressure models: FLT002's prefill demand scales by
+    (1 - hit) and SRV002's per-sequence block need drops by the
+    shared prefix blocks — configs that fire undiscounted go silent
+    at 0.9."""
+    raw = parse_model_config(HITRATE_CONF.format(hit=""))
+    flt = [m for c, m in _codes(fleet_cost_rules, raw) if c == "FLT002"]
+    assert any("prefill capacity" in m for m in flt), flt
+    srv = [m for c, m in _codes(serving_cost_rules, raw)
+           if c == "SRV002"]
+    assert srv, "undiscounted slot concurrency should fire"
+
+    disc = parse_model_config(
+        HITRATE_CONF.format(hit="prefix_hit_rate: 0.9")
+    )
+    flt2 = [m for c, m in _codes(fleet_cost_rules, disc)
+            if c == "FLT002"]
+    assert not any("prefill capacity" in m for m in flt2), flt2
+    assert not [m for c, m in _codes(serving_cost_rules, disc)
+                if c == "SRV002"]
+
+    # the discount is gated on the cache actually being enabled
+    gated = parse_model_config(
+        HITRATE_CONF.format(hit="prefix_hit_rate: 0.9").replace(
+            "enabled: true", "enabled: false"
+        )
+    )
+    flt3 = [m for c, m in _codes(fleet_cost_rules, gated)
+            if c == "FLT002"]
+    assert any("prefill capacity" in m for m in flt3), flt3
