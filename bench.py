@@ -712,6 +712,57 @@ def bench_lm_d128_fleetprefix():
     }
 
 
+def bench_lm_d128_rollout():
+    """Live weight rollout under load on the serving shape: two
+    unified fleet hosts serve the workload while the rollout controller
+    (serve/rollout.py) hot-swaps a new weight version mid-bench —
+    canary one host, parity-probe it against a reference engine on the
+    staged weights, promote the fleet. `tokens_per_s` is the row value
+    (throughput of the run that absorbed the swap); `pre_flip_streams`
+    / `pre_flip_mismatches` pin flip identity (streams retired before
+    the flip are bitwise the no-rollout oracle), `verdict` must be
+    `promoted` and every host must land on v1 with zero hung streams —
+    the numbers a regression in staging, the tick-boundary flip, the
+    cache purge, or the parity gate would move."""
+    import io
+    import time
+    from contextlib import redirect_stdout
+
+    from singa_tpu.tools import serve_bench
+
+    buf = io.StringIO()
+    t0 = time.perf_counter()
+    with redirect_stdout(buf):
+        serve_bench.main([
+            "--d_model", "256", "--n_heads", "2", "--d_ff", "1024",
+            "--requests", "12", "--max_new", "16", "--no_gate",
+            "--rollout", "promote", "--fleet_hosts", "unified,unified",
+            "--rollout_at_tick", "12", "--prompt_len", "8",
+            "--block_len", "8", "--prefill_chunk", "8",
+        ])
+    wall_s = time.perf_counter() - t0
+    r = json.loads(buf.getvalue().strip().splitlines()[-1])
+    return {
+        "name": "lm_d128_rollout",
+        # the drill JSON reports identity/verdict fields, not a
+        # throughput — the row value is workload tokens over the
+        # whole drill's wall clock (oracle + swap run + probes)
+        "value": round(r["requests"] * 16 / wall_s, 1),
+        "unit": "tokens/sec",
+        "verdict": r.get("verdict"),
+        "versions": r.get("versions"),
+        "finished": r.get("finished"),
+        "hung": r.get("hung"),
+        "pre_flip_streams": r.get("pre_flip_streams"),
+        "pre_flip_mismatches": r.get("pre_flip_mismatches"),
+        "rollbacks": r.get("rollbacks"),
+        "torn_ships": r.get("torn_ships"),
+        "gate_pass": r.get("pass"),
+        "method": "serve_bench --rollout promote (mid-bench hot-swap "
+        "vs no-rollout oracle, drill wall clock)",
+    }
+
+
 def bench_lm_d128_fusedattn():
     """Fused paged attention on the serving shape: the same engine as
     `lm_d128_serve` with `kernels { paged_attention: fused }` — the
@@ -791,6 +842,7 @@ BENCHES = (
     ("lm_d128_spec", bench_lm_d128_spec),
     ("lm_d128_prefix", bench_lm_d128_prefix),
     ("lm_d128_fleetprefix", bench_lm_d128_fleetprefix),
+    ("lm_d128_rollout", bench_lm_d128_rollout),
     ("lm_d128_fusedattn", bench_lm_d128_fusedattn),
     ("resnet50", bench_resnet50),
     ("resnet50_fastbn", bench_resnet50_fastbn),

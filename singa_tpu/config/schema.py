@@ -892,6 +892,41 @@ class WireConfig(Message):
     }
 
 
+class RolloutConfig(Message):
+    """singa-tpu extension: live weight rollout into a RUNNING fleet
+    (serve/rollout.py) — the controller stages next-version params
+    alongside the live ones on every host (dual-resident until the
+    flip; netlint ROL001 prices the extra HBM), canaries ONE
+    decode-capable host, verifies stream parity on replayed probe
+    traffic, then promotes host-by-host; a parity mismatch rolls the
+    whole fleet back to the pinned current version."""
+
+    FIELDS = {
+        # next-version weights: an npz save, a sharded checkpoint dir,
+        # or a retention folder (its newest complete save wins) —
+        # restored through resilience/reshard.load_serving_params, so
+        # ANY saved topology stages onto ANY serving host
+        "checkpoint": Field("string", ""),
+        # version tag the flip installs; 0 = derive from the save's
+        # step (a rollout must always move to a NEW, nonzero version)
+        "version": Field("int", 0),
+        # the decode-capable host canaried first ("" = the first
+        # decode-capable peer in rank order)
+        "canary": Field("string", ""),
+        # replayed probe streams the canary parity check verifies
+        # against a reference engine on the staged weights
+        "parity_probes": Field("int", 4),
+        # tokens each probe stream decodes
+        "probe_tokens": Field("int", 8),
+        # per-host deadline for a stage/flip/probe acknowledgment
+        # before the rollout declares the host dead and PAUSES
+        "stage_timeout_s": Field("float", 30.0),
+        # CRC-rejected weight ships retried this many times before the
+        # version is quarantined (serving stays on current throughout)
+        "ship_retries": Field("int", 2),
+    }
+
+
 class FleetConfig(Message):
     """singa-tpu extension: the disaggregated serving fleet
     (singa_tpu/serve/fleet/) — the serving-scale analog of the
@@ -939,6 +974,10 @@ class FleetConfig(Message):
         # offered-load model for the cost-aware shardlint's per-role
         # fleet sizing (FLT002); absent = no declared load, rule skipped
         "load": Field("message", message=FleetLoadConfig),
+        # live weight rollout: canaried, health-gated hot-swap of a
+        # next-version checkpoint into the running fleet
+        # (serve/rollout.py; netlint ROL001 checks feasibility)
+        "rollout": Field("message", message=RolloutConfig),
     }
 
 

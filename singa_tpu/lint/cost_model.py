@@ -793,6 +793,36 @@ def cost_rules(
             fix_hint="shard wider (zero_update, model/expert axes), "
             "shrink the model/batch, or raise device_hbm_bytes",
         )
+    # live weight rollout (serve/rollout.py): during the stage window
+    # a host holds TWO resident param trees — the serving copy and the
+    # staged next version — so a fleet whose steady-state footprint
+    # fits can still OOM the moment a weight_ship lands. Only the
+    # headroom arm fires here: a steady-state overflow is already
+    # MEM001 above, and doubling down would be noise.
+    ro = getattr(getattr(model_cfg, "fleet", None) or object(),
+                 "rollout", None)
+    if (
+        ro is not None
+        and (ro.version or ro.checkpoint or ro.canary)
+        and budget > 0
+        and report.hbm_bytes <= budget
+        and report.hbm_bytes + report.param_bytes > budget
+    ):
+        from .net_rules import ROL001
+
+        col.emit(
+            ROL001,
+            path,
+            "live rollout stages a second resident param tree: "
+            f"footprint {_fmt_bytes(report.hbm_bytes)} + staged params "
+            f"{_fmt_bytes(report.param_bytes)} = "
+            f"{_fmt_bytes(report.hbm_bytes + report.param_bytes)} "
+            f"exceeds device_hbm_bytes ({_fmt_bytes(budget)}) during "
+            "the stage window — the hot-swap would OOM a host that "
+            "serves fine at steady state",
+            fix_hint="free HBM headroom >= one param tree (shrink the "
+            "KV pool / model, or raise device_hbm_bytes)",
+        )
     if (
         comm_fraction > 0
         and report.compute_bytes > 0

@@ -112,6 +112,15 @@ WIR001 = rule(
     "send deadline that cannot cover one max-size migration message "
     "(or, with the prefix cache on, one max-prefix cache_ship frame)",
 )
+ROL001 = rule(
+    "ROL001",
+    ERROR,
+    "live weight rollout infeasible: no checkpoint to ship, a canary "
+    "that is not a declared decode-capable host (or names the whole "
+    "single-host fleet), degenerate probe/retry knobs, or "
+    "dual-resident staged params that overflow the --cluster "
+    "device_hbm_bytes budget (cost model)",
+)
 
 #: reverse of schema.ENUM_ALIASES: [sic] token -> corrected spelling
 _TYPO_NOTES = {v: k for k, v in schema.ENUM_ALIASES.items()}
@@ -699,6 +708,113 @@ def fleet_rules(model_cfg: ModelConfig, path: str, col: Collector) -> None:
             "config at construction",
             fix_hint="add a peers { name: ... role: decode } entry, "
             "or run role: unified",
+        )
+
+
+def rollout_rules(
+    model_cfg: ModelConfig, path: str, col: Collector
+) -> None:
+    """ROL001 — static mirrors of the live-rollout controller's launch
+    rejections and its two config-only failure modes
+    (serve/rollout.py). A ``fleet { rollout {} }`` block counts as
+    CONFIGURED once any of version / checkpoint / canary is set; an
+    all-defaults block is inert and skipped. Arms, reported
+    independently:
+
+    (a) configured without a ``checkpoint``: the controller has no
+        next-version weights to ship and rejects at launch.
+    (b) a ``canary`` that is not a declared peer (the controller
+        rejects at construction), or one whose declared role is
+        ``prefill``: parity probes ride the real serving path, and a
+        prefill host's decode phase is gated off — its probe streams
+        can NEVER finish, so the canary "fails" by timeout every time,
+        a pure config bug that reads like a bad rollout.
+    (c) a ``canary`` named in a single-host fleet: the canary IS the
+        whole fleet, so a parity mismatch has no un-flipped host to
+        keep serving during the rollback window.
+    (d) degenerate knobs that disable the health gate instead of
+        tuning it (zero probes, zero probe budget, non-positive
+        stage-ack window, negative retry budget).
+
+    The dual-resident HBM arm (staged params double the weight
+    footprint for the stage window) lives in the cost model
+    (lint/cost_model.py), where the per-device bytes are computed."""
+    fleet = getattr(model_cfg, "fleet", None)
+    if fleet is None:
+        return
+    ro = getattr(fleet, "rollout", None)
+    if ro is None:
+        return
+    if not (ro.version or ro.checkpoint or ro.canary):
+        return
+    if not ro.checkpoint:
+        col.emit(
+            ROL001,
+            path,
+            "fleet rollout declared (version/canary set) without a "
+            "checkpoint — the controller has no next-version weights "
+            "to ship and rejects at launch",
+            fix_hint='set rollout { checkpoint: "<npz save | sharded '
+            'dir | retention folder>" }',
+        )
+    peers = fleet.peers or []
+    roles = {p.name: p.role for p in peers}
+    if ro.canary and peers:
+        if ro.canary not in roles:
+            col.emit(
+                ROL001,
+                path,
+                f"rollout canary {ro.canary!r} is not a declared "
+                f"peers entry ({', '.join(sorted(roles))}) — the "
+                "controller rejects at construction",
+                fix_hint="name an existing peers entry (or omit "
+                "canary to take the first decode-capable host)",
+            )
+        elif roles[ro.canary] == "prefill":
+            col.emit(
+                ROL001,
+                path,
+                f"rollout canary {ro.canary!r} has role prefill — its "
+                "decode phase is gated off, so parity probe streams "
+                "can never finish: every canary would 'fail' by probe "
+                "timeout, a config bug that reads like a bad rollout",
+                fix_hint="pick a decode/unified peer as the canary",
+            )
+    n_declared = len(peers) or (fleet.max_hosts or 0)
+    if ro.canary and n_declared == 1:
+        col.emit(
+            ROL001,
+            path,
+            f"rollout canary {ro.canary!r} named in a single-host "
+            "fleet — the canary IS the whole fleet, so a parity "
+            "mismatch leaves no un-flipped host serving during the "
+            "rollback window",
+            fix_hint="drop the canary (single-host rollouts flip "
+            "in place) or declare more hosts",
+        )
+    for knob, val, lo in (
+        ("parity_probes", ro.parity_probes, 1),
+        ("probe_tokens", ro.probe_tokens, 1),
+        ("ship_retries", ro.ship_retries, 0),
+    ):
+        if val < lo:
+            col.emit(
+                ROL001,
+                path,
+                f"rollout {knob} {val} < {lo} — the health gate "
+                "cannot run with a degenerate budget",
+                fix_hint=f"set rollout {{ {knob}: >= {lo} }} (or omit "
+                "for the default)",
+            )
+    if ro.stage_timeout_s <= 0:
+        col.emit(
+            ROL001,
+            path,
+            f"rollout stage_timeout_s {ro.stage_timeout_s:g} <= 0 — a "
+            "zero stage-ack window reads every healthy host as a "
+            "swap_die pause",
+            fix_hint="set rollout { stage_timeout_s: > 0 } (or omit "
+            "for the default)",
         )
 
 
@@ -1371,6 +1487,7 @@ def lint_model_text(
     graph_rules(model_cfg, path, col)
     serving_rules(model_cfg, path, col)
     fleet_rules(model_cfg, path, col)
+    rollout_rules(model_cfg, path, col)
     wire_rules(model_cfg, path, col)
     kernel_rules(model_cfg, path, col)
     if widths:

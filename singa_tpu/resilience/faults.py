@@ -92,6 +92,14 @@ KINDS = (
     "slowstep",
     "async_torn_write",
     "profile",
+    # live-rollout faults (serve/rollout.py): keyed on weight-ship
+    # ORDINALS — `at` is the K-th weight_ship this HOST receives
+    # (scope with :rank=K in multi-process drills). torn_weights tears
+    # the staged artifact so the CRC rejects it (retry then quarantine,
+    # serving uninterrupted); swap_die kills the host mid-stage
+    # (tombstone -> failover per the wire path, rollout pauses)
+    "torn_weights",
+    "swap_die",
     # wire faults (comm/faults.py): keyed on message-send ORDINALS,
     # not steps — `at` is the K-th transport send this process makes.
     # ``wire_delay@K:ms=N`` stalls N ms; ``:peer=H`` scopes a term to

@@ -337,3 +337,106 @@ class Resharder:
             f"resharded {n} entr{'y' if n == 1 else 'ies'} from the "
             f"{self.saved_nprocs}-process layout ({preview}{more})"
         )
+
+
+# ---------------------------------------------------------------------------
+# in-process serving restore — the live-rollout staging path
+# ---------------------------------------------------------------------------
+
+
+def load_serving_params(
+    path: str, init_params: dict, *, log=None
+) -> tuple[dict, dict]:
+    """Restore a trained save's PARAM tree onto an in-process serving
+    host -> ``(params, info)``. This is what both boot-time checkpoint
+    threading (``fleet/host.run_from_conf``) and the live-rollout
+    controller's staging (``serve/rollout.py``) call: ANY save restores
+    onto ANY serving topology —
+
+      - a retention FOLDER resolves through its LATEST marker (newest
+        complete save wins, torn tails skipped — resilience/retention);
+      - an npz checkpoint overlays by flat param name (the kPretrained
+        contract: absent names keep their init, shape mismatches raise);
+      - a SHARDED checkpoint dir reshard-restores through
+        ``Resharder.place`` onto the serving host's replicated device —
+        a save written by N training processes lands here regardless
+        of N, the PR 15 box-intersection path.
+
+    ``init_params`` is the freshly-initialized tree (``init_lm``) whose
+    names/shapes define what the serving engine can host. Raises
+    ``ReshardError``/``ValueError`` loudly on an unhostable or absent
+    save — a serving fleet must never boot on silently-wrong weights."""
+    import os
+
+    if os.path.isdir(path) and not os.path.exists(
+        os.path.join(path, "manifest.json")
+    ):
+        from .retention import resolve_latest
+
+        resolved = resolve_latest(path)
+        if resolved is None:
+            raise ReshardError(
+                f"checkpoint folder {path!r} holds no complete save"
+            )
+        return load_serving_params(resolved, init_params, log=log)
+
+    if os.path.isdir(path):
+        import jax
+
+        from ..trainer.sharded_ckpt import ShardedCheckpoint, param_key
+
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        restored = 0
+        out = dict(init_params)
+        with ShardedCheckpoint(path) as ck:
+            rs = Resharder(ck, log=log)
+            saved = set(ck.keys())
+            for name, live in init_params.items():
+                key = param_key(name)
+                if key not in saved:
+                    continue
+                shape = tuple(ck.manifest["arrays"][key]["shape"])
+                want = tuple(np.asarray(live).shape)
+                if shape != want:
+                    raise ReshardError(
+                        f"checkpoint {path!r}: param {name!r} shape "
+                        f"{shape} != model shape {want}"
+                    )
+                out[name] = rs.place(key, sharding)
+                restored += 1
+            info = {
+                "path": path,
+                "step": int(ck.step),
+                "format": "sharded",
+                "saved_nprocs": rs.saved_nprocs,
+                "restored": restored,
+                "resharded": len(rs.resharded_keys),
+            }
+        if log is not None and rs.summary():
+            log(f"serving restore: {rs.summary()}")
+        return out, info
+
+    from ..trainer.checkpoint import load_checkpoint
+
+    step, ck_params, _, _ = load_checkpoint(path)
+    out = dict(init_params)
+    restored = 0
+    for name, arr in ck_params.items():
+        if name not in out:
+            continue
+        if tuple(arr.shape) != tuple(np.asarray(out[name]).shape):
+            raise ReshardError(
+                f"checkpoint {path!r}: param {name!r} shape "
+                f"{tuple(arr.shape)} != model shape "
+                f"{tuple(np.asarray(out[name]).shape)}"
+            )
+        out[name] = arr
+        restored += 1
+    return out, {
+        "path": path,
+        "step": int(step),
+        "format": "npz",
+        "saved_nprocs": 1,
+        "restored": restored,
+        "resharded": 0,
+    }

@@ -236,12 +236,22 @@ def resolve_latest(folder: str | None) -> str | None:
     return None
 
 
-def apply_retention(folder: str, keep_last: int) -> list[str]:
+def apply_retention(folder: str, keep_last: int, *,
+                    current_nprocs: int | None = None) -> list[str]:
     """Delete all but the newest ``keep_last`` complete checkpoints
     (invalid ones are deleted regardless — they can never be restored
     — except the newest entry, which may still be mid-write by a
     concurrent saver). The LATEST target always survives. Returns the
-    deleted paths. ``keep_last <= 0`` keeps everything."""
+    deleted paths. ``keep_last <= 0`` keeps everything.
+
+    With ``current_nprocs`` given (the live job's process count), the
+    keep budget PREFERS saves written by the current topology: a
+    sharded save from a since-resized job restores only through the
+    reshard path, so when trimming, stale-topology saves evict first —
+    newest current-topology saves fill the budget, then the newest
+    stale ones take whatever budget remains. npz saves are
+    topology-agnostic (host-assembled, restorable anywhere) and always
+    count as current. ``None`` keeps the pure newest-first order."""
     if keep_last <= 0:
         return []
     marker = os.path.join(folder, LATEST_MARKER)
@@ -251,11 +261,29 @@ def apply_retention(folder: str, keep_last: int) -> list[str]:
             pinned = f.read().strip()
     except OSError:
         pass
+    entries = [
+        (path, validate_checkpoint(path))
+        for path in list_checkpoints(folder)
+    ]
+    keep_set: set[str] | None = None
+    if current_nprocs is not None:
+        from .reshard import checkpoint_nprocs
+
+        def stale(path: str) -> bool:
+            nprocs = checkpoint_nprocs(path)
+            return nprocs is not None and nprocs != current_nprocs
+
+        ranked = [p for p, valid in entries if valid and not stale(p)]
+        ranked += [p for p, valid in entries if valid and stale(p)]
+        keep_set = set(ranked[:keep_last])
     deleted: list[str] = []
     kept = 0
-    for i, path in enumerate(list_checkpoints(folder)):
-        valid = validate_checkpoint(path)
-        keep = (valid and kept < keep_last) or (
+    for i, (path, valid) in enumerate(entries):
+        if keep_set is None:
+            keep = valid and kept < keep_last
+        else:
+            keep = path in keep_set
+        keep = keep or bool(
             pinned and os.path.basename(path) == pinned
         )
         if not valid and i == 0:

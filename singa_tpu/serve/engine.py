@@ -228,6 +228,19 @@ class Engine:
             tail_stride=self.serving.prefix_tail_stride,
         )
         self.params = params
+        #: live-weight rollout versioning (serve/rollout.py): the tag of
+        #: the LIVE param tree. A staged next-version tree sits alongside
+        #: it until flip_params() swaps the reference at a tick boundary
+        #: — every jitted program takes params per call, so the swap is
+        #: atomic between ticks and recompiles nothing (same shapes).
+        self.params_version = 0
+        self._staged: tuple[int, dict] | None = None
+        #: the pinned previous version a canary-abort rolls back to
+        self._prev: tuple[int, dict] | None = None
+        #: params version each slot was admitted/imported under — its
+        #: K/V bytes are a function of THOSE weights, so registration
+        #: into the prefix index is gated on the version still being live
+        self._slot_version: dict[int, int] = {}
         s, mb = self.serving.slots, self.pool.max_blocks_per_seq
         shape = (
             self.pool.n_blocks, cfg.n_heads,
@@ -849,6 +862,7 @@ class Engine:
         )
         self._slot_blocks[slot] = blocks
         self._slot_chain[slot] = chain
+        self._slot_version[slot] = self.params_version
         return Admission(
             blocks=blocks,
             cached_tokens=cached,
@@ -869,6 +883,12 @@ class Engine:
         -> newly registered blocks."""
         cache = self.allocator.cache
         if cache is None:
+            return 0
+        if self._slot_version.get(slot, self.params_version) \
+                != self.params_version:
+            # the slot's bytes were prefilled under a now-replaced
+            # version (a rollout flipped mid-flight) — indexing them
+            # would poison new-version admissions
             return 0
         blocks = self._slot_blocks.get(slot)
         if not blocks:
@@ -906,6 +926,11 @@ class Engine:
         ``len(tokens) - 1`` positions. -> newly registered blocks."""
         cache = self.allocator.cache
         if cache is None:
+            return 0
+        if self._slot_version.get(slot, self.params_version) \
+                != self.params_version:
+            # stale-version slot (admitted before a rollout flip): its
+            # decode-written bytes belong to the old weights — skip
             return 0
         blocks = self._slot_blocks.get(slot)
         if not blocks:
@@ -1054,6 +1079,7 @@ class Engine:
         )
         self._slot_blocks[slot] = blocks
         self._slot_chain[slot] = chain
+        self._slot_version[slot] = self.params_version
         registered = 0
         if alloc.cache is not None:
             for i, digest in enumerate(chain[:n]):
@@ -1157,6 +1183,94 @@ class Engine:
         lane."""
         self.state = self._retire_jit(self.state, jnp.int32(slot))
         self._slot_chain.pop(slot, None)
+        self._slot_version.pop(slot, None)
         blocks = self._slot_blocks.pop(slot, None)
         if blocks:
             self.allocator.release(blocks)
+
+    # ------------------------------------------------------------------
+    # live weight rollout (serve/rollout.py): dual-version param slots
+    # ------------------------------------------------------------------
+
+    @property
+    def staged_version(self) -> int | None:
+        """Version tag of the staged (not yet live) tree, or None."""
+        return self._staged[0] if self._staged is not None else None
+
+    def stage_params(self, params: dict, version: int) -> int:
+        """Hold next-version ``params`` ALONGSIDE the live tree (dual-
+        resident: both fit in HBM until the flip — netlint ROL001 prices
+        this statically). Validated against the live tree's exact
+        key set, shapes, and dtypes: the compiled programs are reused
+        across the flip, so a mismatched save must be rejected HERE,
+        loudly, never staged. -> staged byte count."""
+        version = int(version)
+        if version == self.params_version:
+            raise ValueError(
+                f"stage_params: version {version} is already live"
+            )
+        cur = self.params
+        missing = sorted(set(cur) - set(params))
+        extra = sorted(set(params) - set(cur))
+        if missing or extra:
+            raise ValueError(
+                f"stage_params v{version}: param tree mismatch "
+                f"(missing {missing[:3]}, extra {extra[:3]})"
+            )
+        nbytes = 0
+        for name, live in cur.items():
+            a = np.asarray(params[name])
+            if tuple(a.shape) != tuple(live.shape):
+                raise ValueError(
+                    f"stage_params v{version}: {name!r} shape "
+                    f"{tuple(a.shape)} != live {tuple(live.shape)}"
+                )
+            if a.dtype != np.asarray(live).dtype:
+                raise ValueError(
+                    f"stage_params v{version}: {name!r} dtype "
+                    f"{a.dtype} != live {np.asarray(live).dtype}"
+                )
+            nbytes += a.nbytes
+        self._staged = (version, dict(params))
+        return nbytes
+
+    def unstage(self) -> None:
+        """Drop the staged tree (a quarantined/aborted version)."""
+        self._staged = None
+
+    def flip_params(self) -> dict:
+        """Atomic tick-boundary hot-swap: the staged tree becomes live,
+        the previous tree stays PINNED for rollback, and the prefix
+        cache is purged (its bytes were written under the old weights —
+        a warm hit across versions would poison the pool). In-flight
+        slots ride through on their already-written K/V; nothing drains.
+        -> {"version", "prev_version", "purged_blocks"}."""
+        if self._staged is None:
+            raise ValueError("flip_params: nothing staged")
+        version, params = self._staged
+        self._prev = (self.params_version, self.params)
+        self.params, self.params_version = params, version
+        self._staged = None
+        return {
+            "version": version,
+            "prev_version": self._prev[0],
+            "purged_blocks": self.allocator.purge_cache(),
+        }
+
+    def rollback_params(self) -> dict:
+        """Restore the pinned previous version (canary parity abort).
+        Purges the cache again — blocks written under the aborted
+        version are garbage to the restored one. Idempotent hazard-free:
+        raises if no previous version is pinned."""
+        if self._prev is None:
+            raise ValueError("rollback_params: no previous version pinned")
+        version, params = self._prev
+        aborted = self.params_version
+        self.params, self.params_version = params, version
+        self._prev = None
+        self._staged = None
+        return {
+            "version": version,
+            "aborted_version": aborted,
+            "purged_blocks": self.allocator.purge_cache(),
+        }

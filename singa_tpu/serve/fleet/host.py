@@ -46,6 +46,7 @@ import time
 import numpy as np
 
 from ...comm.wire import WireError
+from ...resilience.faults import InjectedCrash
 from ...resilience.preemption import EXIT_RESUMABLE
 from ..engine import Engine, EngineConfig
 from ..kv_pool import PoolExhausted
@@ -65,6 +66,12 @@ ROLES = ("unified", "prefill", "decode")
 #: the well-known mailbox finished streams are reported to when a host
 #: runs detached from its driver (``results_to``)
 FRONTDOOR = "frontdoor"
+
+#: rollout parity probes ride the normal request path under reserved
+#: rids at/below this base (probe i -> PROBE_RID_BASE - i); their
+#: finished streams report to the rollout controller over the
+#: ``rollout`` channel, never to the front door
+PROBE_RID_BASE = -1_000_000
 
 
 def role_for_rank(fleet_cfg, rank: int) -> str:
@@ -110,7 +117,7 @@ class FleetHost:
                  *, peers: dict[str, str] | None = None,
                  latent: set[str] | None = None, recorder=None,
                  preemption=None, results_to: str | None = None,
-                 log=lambda s: None):
+                 fault_plan=None, log=lambda s: None):
         if role not in ROLES:
             raise ValueError(f"fleet role must be one of {ROLES}, got "
                              f"{role!r}")
@@ -200,6 +207,16 @@ class FleetHost:
         self.ship_blocks_out = 0
         self.ship_bytes_in = 0
         self.ship_bytes_out = 0
+        #: rollout fault hooks (resilience/faults.py): torn_weights /
+        #: swap_die key on weight-ship ordinals counted PER HOST
+        self._fault_plan = fault_plan
+        self._ship_seen = 0
+        #: in-flight parity probes (rollout controller): reserved rids
+        #: still running -> finished streams collected so far, plus the
+        #: controller mailbox the probe_done report goes back to
+        self._probe_wait: set[int] = set()
+        self._probe_streams: dict[int, list[int]] = {}
+        self._probe_reply_to: str | None = None
         transport.register(name)
         # run-start provenance: which role this rank serves — the
         # cross-rank merge keys its per-host rows on this event
@@ -384,6 +401,7 @@ class FleetHost:
         emitted = self.sched.tick()
         if self.role == "prefill":
             self._export_ready()
+        self._flush_probes()
         self._flush_results()
         self.publish_status()
         return emitted
@@ -423,6 +441,10 @@ class FleetHost:
                 self._serve_fetch(msg)
             elif msg.kind == "cache_ship":
                 self._install_ship(msg)
+            elif msg.kind == "weight_ship":
+                self._handle_weight_ship(msg)
+            elif msg.kind == "rollout":
+                self._handle_rollout(msg)
             elif msg.kind == "shutdown":
                 self._shutdown = True
 
@@ -440,6 +462,33 @@ class FleetHost:
                 break
             mseq, src = self._pending[0]
             slot = free[0]
+            if mseq.version != self.engine.params_version:
+                # version skew (mid-rollout fleet): the migrated KV was
+                # written by DIFFERENT weights — scattering it into our
+                # pool would poison the prefix cache and splice two
+                # models into one stream. Degrade to a cold re-prefill
+                # from the original prompt under OUR weights: emitted
+                # tokens only ever deliver at finish (_flush_results),
+                # so the client still sees exactly one consistent
+                # stream. Never a drop, never a poisoned pool.
+                self._pending.pop(0)
+                req = Request(
+                    rid=mseq.rid,
+                    prompt=np.asarray(mseq.prompt, np.int32),
+                    max_new_tokens=mseq.max_new_tokens,
+                    temperature=mseq.temperature,
+                    seed=mseq.seed,
+                    eos=None if mseq.eos is None else int(mseq.eos),
+                )
+                self.migrate_in += 1
+                self._event(
+                    "migrate_in", rid=req.rid, src=src, slot=-1,
+                    blocks=0, shared=0, registered=0, tokens_done=0,
+                    skew=True, frame_version=mseq.version,
+                    live_version=self.engine.params_version,
+                )
+                self.sched.submit(req)
+                continue
             try:
                 info = migrate.import_sequence(self.engine, slot, mseq)
             except PoolExhausted:
@@ -548,7 +597,10 @@ class FleetHost:
             try:
                 self.transport.send(
                     best, "cache_fetch",
-                    migrate.serialize_fetch(req.rid, chain),
+                    migrate.serialize_fetch(
+                        req.rid, chain,
+                        version=self.engine.params_version,
+                    ),
                     src=self.name,
                 )
             except WireError as e:
@@ -596,14 +648,25 @@ class FleetHost:
         instead of waiting out its deadline on our stale
         advertisement."""
         try:
-            rid, chain = migrate.deserialize_fetch(msg.payload)
+            rid, chain, version = migrate.deserialize_fetch(msg.payload)
         except ValueError as e:
             self.log(f"fleet host {self.name}: bad cache_fetch from "
                      f"{msg.src!r}: {e}")
             return
         cache = self.engine.allocator.cache
         blocks: list[int] = []
-        if cache is not None:
+        if version != self.engine.params_version:
+            # version skew (mid-rollout fleet): our cached KV was
+            # written by weights the requester is not running — answer
+            # with the EXISTING empty ship so it degrades to plain
+            # prefill immediately instead of installing poison (or
+            # waiting out its deadline)
+            self._event(
+                "cache_fetch", rid=rid, peer=msg.src, dir="serve",
+                skew=True, frame_version=version,
+                live_version=self.engine.params_version,
+            )
+        elif cache is not None:
             blocks = cache.match_chain(chain)[
                 : self.engine.pool.max_blocks_per_seq
             ]
@@ -620,7 +683,10 @@ class FleetHost:
             )
             k = np.zeros(shape, np.float32)
             v = np.zeros(shape, np.float32)
-        data = migrate.serialize_ship(rid, chain[: len(blocks)], k, v)
+        data = migrate.serialize_ship(
+            rid, chain[: len(blocks)], k, v,
+            version=self.engine.params_version,
+        )
         try:
             self.transport.send(msg.src, "cache_ship", data,
                                 src=self.name)
@@ -651,6 +717,14 @@ class FleetHost:
             return
         waiting = self._awaiting.pop(ship["rid"], None)
         installed = shared = 0
+        skew = ship["version"] != self.engine.params_version
+        if skew:
+            # version skew: the shipped KV was written under different
+            # weights (the sender flipped — or we did — between fetch
+            # and ship). Installing it would poison the pool; skip the
+            # scatter but STILL release every held request below, so
+            # worst case stays plain prefill
+            ship = dict(ship, chain=[])
         if ship["chain"]:
             try:
                 info = self.engine.install_prefix(
@@ -674,6 +748,7 @@ class FleetHost:
             cached_tokens=int(
                 (installed + shared) * self.engine.pool.block_len
             ),
+            skew=skew,
         )
         # release the ship's own request AND every piggybacked hold
         # whose first uncovered block the installed chain covers — they
@@ -686,6 +761,187 @@ class FleetHost:
                 self.sched.submit(held)
         if waiting is not None:
             self.sched.submit(waiting[0])
+
+    # -- live weight rollout (serve/rollout.py) -------------------------
+
+    def _rollout_ack(self, dst: str, cmd: str, **fields) -> None:
+        """One control reply to the rollout controller (kind
+        ``rollout``). A dead controller is a tombstone like any other
+        peer — the rollout pauses on ITS timeout, the host keeps
+        serving."""
+        body = {"cmd": cmd, "host": self.name}
+        body.update(fields)
+        try:
+            self.transport.send(
+                dst, "rollout", json.dumps(body).encode("utf-8"),
+                src=self.name,
+            )
+        except WireError as e:
+            self._mark_dead(dst, str(e))
+
+    def _handle_weight_ship(self, msg) -> None:
+        """Stage a shipped next-version param tree alongside the live
+        one (engine.stage_params). Serving is untouched either way: a
+        torn frame (CRC/format reject) nacks back to the controller —
+        which retries, then quarantines the version — while the live
+        weights keep answering every stream."""
+        self._ship_seen += 1
+        payload = msg.payload
+        if self._fault_plan is not None:
+            if self._fault_plan.fire("swap_die", at=self._ship_seen):
+                # host death mid-stage: propagates out of the serve
+                # loop; peers tombstone it (liveness), streams fail
+                # over, and the controller's stage-ack timeout turns
+                # the rollout verdict into "paused"
+                raise InjectedCrash(
+                    f"fleet host {self.name}: swap_die at weight_ship "
+                    f"{self._ship_seen}"
+                )
+            if self._fault_plan.fire("torn_weights", at=self._ship_seen):
+                # tear the bulk frame in half: the codec's CRC (or the
+                # npz container itself) must reject it downstream
+                payload = payload[: max(1, len(payload) // 2)]
+        try:
+            version, tree = migrate.deserialize_weights(payload)
+        except Exception as e:  # torn frame: format/CRC/zip all land here
+            self._event(
+                "weight_ship", dir="in", ok=False,
+                bytes=len(payload), error=str(e)[:200],
+            )
+            self.log(f"fleet host {self.name}: rejected weight_ship "
+                     f"from {msg.src!r}: {e}")
+            self._rollout_ack(msg.src, "stage_ack", ok=False,
+                             error="torn")
+            return
+        try:
+            staged_bytes = self.engine.stage_params(tree, version)
+        except ValueError as e:
+            self._event(
+                "rollout_stage", version=version, ok=False,
+                error=str(e)[:200],
+            )
+            self._rollout_ack(msg.src, "stage_ack", ok=False,
+                             version=version, error=str(e)[:200])
+            return
+        self._event(
+            "weight_ship", dir="in", ok=True, version=version,
+            bytes=len(msg.payload),
+        )
+        self._event(
+            "rollout_stage", version=version, ok=True,
+            staged_bytes=staged_bytes,
+        )
+        self._rollout_ack(msg.src, "stage_ack", ok=True, version=version)
+
+    def _handle_rollout(self, msg) -> None:
+        """Rollout control plane: flip / rollback / unstage / probe.
+        The handler runs in _recv, BETWEEN scheduler ticks — applying a
+        flip here IS the atomic tick boundary: no stream ever decodes
+        one token under each version within a tick."""
+        try:
+            body = json.loads(msg.payload.decode("utf-8"))
+        except ValueError as e:
+            self.log(f"fleet host {self.name}: bad rollout frame from "
+                     f"{msg.src!r}: {e}")
+            return
+        cmd = body.get("cmd")
+        if cmd == "flip":
+            try:
+                res = self.engine.flip_params()
+            except ValueError as e:
+                self._rollout_ack(msg.src, "flip_ack", ok=False,
+                                 error=str(e)[:200])
+                return
+            self._event(
+                "rollout_flip", version=res["version"],
+                prev_version=res["prev_version"], tick=self.sched.ticks,
+                purged_blocks=res["purged_blocks"],
+            )
+            self.log(f"fleet host {self.name}: flipped to weights "
+                     f"v{res['version']} at tick {self.sched.ticks} "
+                     f"(purged {res['purged_blocks']} cached blocks)")
+            self._rollout_ack(msg.src, "flip_ack", ok=True,
+                             version=res["version"],
+                             tick=self.sched.ticks)
+        elif cmd == "rollback":
+            if self.engine._prev is not None:
+                res = self.engine.rollback_params()
+                self._event(
+                    "rollout_flip", version=res["version"],
+                    rollback=True,
+                    aborted_version=res["aborted_version"],
+                    tick=self.sched.ticks,
+                    purged_blocks=res["purged_blocks"],
+                )
+                self.log(f"fleet host {self.name}: rolled back to "
+                         f"weights v{res['version']} (aborted "
+                         f"v{res['aborted_version']})")
+            else:
+                # never flipped here: just drop anything staged
+                self.engine.unstage()
+            self._rollout_ack(msg.src, "rollback_ack", ok=True,
+                             version=self.engine.params_version)
+        elif cmd == "unstage":
+            self.engine.unstage()
+            self._rollout_ack(msg.src, "unstage_ack", ok=True,
+                             version=self.engine.params_version)
+        elif cmd == "probe":
+            self._start_probes(msg.src, body)
+        else:
+            self.log(f"fleet host {self.name}: unknown rollout cmd "
+                     f"{cmd!r} from {msg.src!r}")
+
+    def _start_probes(self, src: str, body: dict) -> None:
+        """Submit the controller's parity probes through the REAL
+        serving path (scheduler admission, post-flip cold prefill —
+        the cache was purged at the flip, so probes exercise the new
+        weights end to end). Reserved rids keep them out of the front
+        door; _flush_probes reports the finished streams back."""
+        prompts = body.get("prompts") or []
+        max_new = int(body.get("max_new", 8))
+        temperature = float(body.get("temperature", 0.0))
+        seeds = body.get("seeds") or [0] * len(prompts)
+        self._probe_wait = set()
+        self._probe_streams = {}
+        self._probe_reply_to = src
+        for i, prompt in enumerate(prompts):
+            rid = PROBE_RID_BASE - i
+            req = Request(
+                rid=rid, prompt=np.asarray(prompt, np.int32),
+                max_new_tokens=max_new, temperature=temperature,
+                seed=int(seeds[i]),
+            )
+            try:
+                self.sched.submit(req)
+            except ValueError as e:
+                self._rollout_ack(src, "probe_done", ok=False,
+                                 error=str(e)[:200])
+                self._probe_wait = set()
+                self._probe_reply_to = None
+                return
+            self._probe_wait.add(rid)
+        if not self._probe_wait:
+            self._rollout_ack(src, "probe_done", ok=True, streams={})
+            self._probe_reply_to = None
+
+    def _flush_probes(self) -> None:
+        """Collect finished probe streams; when the whole batch is
+        done, report it to the controller in one ``probe_done``."""
+        if not self._probe_wait:
+            return
+        for req in self.sched.finished:
+            if req.rid in self._probe_wait:
+                self._probe_wait.discard(req.rid)
+                self._probe_streams[req.rid] = [int(t) for t in req.tokens]
+        if self._probe_wait:
+            return
+        dst = self._probe_reply_to
+        streams = {str(r): t for r, t in self._probe_streams.items()}
+        self._probe_streams = {}
+        self._probe_reply_to = None
+        if dst is not None:
+            self._rollout_ack(dst, "probe_done", ok=True,
+                             streams=streams)
 
     def _export_ready(self) -> None:
         """Ship every filled (decoding-status) sequence to a decode
@@ -728,6 +984,10 @@ class FleetHost:
         for idx, req in enumerate(new):
             if req.rid in self._reported:
                 continue
+            if req.rid <= PROBE_RID_BASE:
+                # rollout parity probes report over the rollout channel
+                # (_flush_probes), never to the front door
+                continue
             self._reported.add(req.rid)
             try:
                 self.transport.send(
@@ -759,7 +1019,12 @@ class FleetHost:
             "queue_depth": len(self.sched._queue) + len(self._pending)
             + len(self._awaiting),
             "live": len(self.sched._slot_req),
+            # weight version feedback: the rollout controller (and the
+            # router's skew view) read fleet versions off statuses
+            "version": self.engine.params_version,
         }
+        if self.engine.staged_version is not None:
+            s["staged_version"] = self.engine.staged_version
         cache = self.engine.allocator.cache
         if cache is not None:
             # hexing thousands of digests every tick is the hot-path
@@ -986,9 +1251,11 @@ def lm_config_from_conf(model_cfg):
     kEmbedding layer's vocab/width/window, the kAttention layers'
     head count and depth. The fleet serves the code-API LM at that
     geometry with seed-initialized weights (every rank inits the same
-    params from the same seed, the mp drills' discipline); loading
-    trained weights through the ``checkpoint`` field is a README'd
-    remaining item."""
+    params from the same seed, the mp drills' discipline); the conf's
+    ``checkpoint`` field overlays trained weights on top —
+    ``run_from_conf`` threads it through
+    ``resilience.reshard.load_serving_params``, so a save from ANY
+    training topology restores onto this serving host."""
     from ...models.transformer import TransformerConfig
 
     net = model_cfg.neuralnet
@@ -1139,6 +1406,17 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
     root = fleet.mailbox or f"{workspace}/fleet"
     cfg = lm_config_from_conf(model_cfg)
     params = init_lm(jax.random.PRNGKey(seed), cfg)
+    restored = None
+    if model_cfg.checkpoint:
+        from ...resilience.reshard import load_serving_params
+
+        params, restored = load_serving_params(
+            model_cfg.checkpoint, params, log=log,
+        )
+        log(f"fleet host rank {procs_id}: restored "
+            f"{restored['restored']} params from {restored['path']!r} "
+            f"(step {restored['step']}, {restored['format']}, "
+            f"resharded {restored['resharded']})")
     serving = EngineConfig.from_conf(
         model_cfg.serving, getattr(model_cfg, "kernels", None)
     )
@@ -1146,9 +1424,30 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
     recorder = FlightRecorder(
         f"{workspace}/events", rank=procs_id, run_id="fleet",
     )
+    if restored is not None:
+        recorder.event(
+            "weights_restored", step=restored["step"],
+            path=restored["path"], format=restored["format"],
+            restored=restored["restored"],
+            resharded=restored["resharded"],
+            saved_nprocs=restored["saved_nprocs"] or 0,
+        )
     handler = PreemptionHandler()
     handler.install()
     transport = _build_transport(fleet, root, recorder, faults, log=log)
+    # rollout faults (torn_weights@K / swap_die@K) fire at the host's
+    # weight-ship seam — parsed separately from the wire plan (the
+    # transport's WireFaults instance only consumes wire_* kinds)
+    host_plan = None
+    if faults:
+        from ...resilience.faults import FaultPlan
+
+        parsed = FaultPlan.parse(faults)
+        if any(s.kind in ("torn_weights", "swap_die")
+               for s in parsed.specs):
+            parsed.recorder = recorder
+            host_plan = parsed
+            log(f"rollout-fault plan armed: {parsed}")
     log(f"fleet host {name!r} (rank {procs_id}): role {role}, "
         f"transport {getattr(fleet, 'transport', 'mailbox')} ({root})")
     host = FleetHost(
@@ -1156,7 +1455,7 @@ def run_from_conf(model_cfg, cluster_cfg, *, procs_id: int = 0,
         peers={n: r for n, r in topo if n != name},
         latent=latent - {name},
         recorder=recorder, preemption=handler,
-        results_to=FRONTDOOR, log=log,
+        results_to=FRONTDOOR, fault_plan=host_plan, log=log,
     )
     rc, acct = host.serve_forever()
     if acct is not None:

@@ -51,6 +51,7 @@ import dataclasses
 import io
 import json
 import os
+import zlib
 
 import numpy as np
 
@@ -59,6 +60,8 @@ MIGRATE_FORMAT = "singa-tpu-migrate-v1"
 #: fleet prefix-cache frames (cache_fetch request / cache_ship reply)
 FETCH_FORMAT = "singa-tpu-cachefetch-v1"
 SHIP_FORMAT = "singa-tpu-cacheship-v1"
+#: live-rollout weight frames: one bulk message per staged version
+WEIGHT_FORMAT = "singa-tpu-weights-v1"
 
 
 @dataclasses.dataclass
@@ -80,6 +83,11 @@ class MigratedSequence:
     #: survives migration (meaningful within one process/clock domain;
     #: cross-host reports fall back to import-time re-stamping)
     enqueue_mono: float = 0.0
+    #: params version the exporter's K/V bytes were written under: a
+    #: receiver whose live version differs must NOT scatter them
+    #: (mixed-version KV poisons a pool) — it degrades the sequence to
+    #: cold prefill under its own weights instead (serve/fleet/host.py)
+    version: int = 0
 
     @property
     def n_blocks(self) -> int:
@@ -102,6 +110,7 @@ def export_sequence(engine, req, slot: int) -> MigratedSequence:
         eos=req.eos,
         payload=engine.export_slot(slot),
         enqueue_mono=float(req.enqueue_mono),
+        version=int(getattr(engine, "params_version", 0)),
     )
 
 
@@ -134,6 +143,7 @@ def serialize(mseq: MigratedSequence) -> bytes:
         # re-stamps at arrival instead of trusting a foreign clock
         "enqueue_mono": mseq.enqueue_mono,
         "clock": os.getpid(),
+        "version": int(mseq.version),
         "token": int(p["token"]),
         "pos": int(p["pos"]),
         "temp": float(p["temp"]),
@@ -185,6 +195,8 @@ def deserialize(data: bytes) -> MigratedSequence:
                 float(meta.get("enqueue_mono", 0.0))
                 if meta.get("clock") == os.getpid() else 0.0
             ),
+            # pre-rollout senders carry no tag: version 0 by contract
+            version=int(meta.get("version", 0)),
         )
 
 
@@ -193,30 +205,38 @@ def deserialize(data: bytes) -> MigratedSequence:
 # ---------------------------------------------------------------------------
 
 
-def serialize_fetch(rid: int, chain: list[bytes]) -> bytes:
+def serialize_fetch(rid: int, chain: list[bytes],
+                    version: int = 0) -> bytes:
     """A ``cache_fetch``: the requesting host's prompt digest chain
-    (prefix-ordered). The peer matches its longest cached prefix and
-    replies with ONE ``cache_ship``; digests are tiny, so this frame
-    is JSON."""
+    (prefix-ordered) plus its live params ``version`` — a peer at a
+    DIFFERENT version answers with an empty ship (its warm bytes were
+    written under other weights). The peer matches its longest cached
+    prefix and replies with ONE ``cache_ship``; digests are tiny, so
+    this frame is JSON."""
     return json.dumps(
         {"format": FETCH_FORMAT, "rid": int(rid),
-         "chain": [d.hex() for d in chain]}
+         "chain": [d.hex() for d in chain], "version": int(version)}
     ).encode("utf-8")
 
 
-def deserialize_fetch(data: bytes) -> tuple[int, list[bytes]]:
-    """bytes -> (rid, digest chain); raises ValueError on a foreign
-    format."""
+def deserialize_fetch(data: bytes) -> tuple[int, list[bytes], int]:
+    """bytes -> (rid, digest chain, requester's params version); raises
+    ValueError on a foreign format."""
     meta = json.loads(data.decode("utf-8"))
     if meta.get("format") != FETCH_FORMAT:
         raise ValueError(
             f"cache_fetch format {meta.get('format')!r} != "
             f"{FETCH_FORMAT!r}"
         )
-    return int(meta["rid"]), [bytes.fromhex(h) for h in meta["chain"]]
+    return (
+        int(meta["rid"]),
+        [bytes.fromhex(h) for h in meta["chain"]],
+        int(meta.get("version", 0)),
+    )
 
 
-def serialize_ship(rid: int, chain: list[bytes], k, v) -> bytes:
+def serialize_ship(rid: int, chain: list[bytes], k, v,
+                   version: int = 0) -> bytes:
     """A ``cache_ship``: the matched prefix's digests plus its blocks'
     per-layer K/V bytes — ``k``/``v`` shaped (L, n, H, BL, D) from
     ``engine.export_blocks`` — as one bulk npz frame. ``n`` may be 0
@@ -227,6 +247,7 @@ def serialize_ship(rid: int, chain: list[bytes], k, v) -> bytes:
         "format": SHIP_FORMAT,
         "rid": int(rid),
         "chain": [d.hex() for d in chain],
+        "version": int(version),
     }
     buf = io.BytesIO()
     np.savez(
@@ -255,4 +276,64 @@ def deserialize_ship(data: bytes) -> dict:
             "chain": [bytes.fromhex(h) for h in meta["chain"]],
             "k": z["k"],
             "v": z["v"],
+            "version": int(meta.get("version", 0)),
         }
+
+
+# ---------------------------------------------------------------------------
+# live-rollout weight frames
+# ---------------------------------------------------------------------------
+
+
+def serialize_weights(version: int, params: dict) -> bytes:
+    """A ``weight_ship``: one next-version param tree as ONE bulk npz
+    frame — sorted flat names, every array, and an APPLICATION-level
+    CRC32 over the packed bytes. The transport's own frame CRC guards
+    the wire; this one guards the whole staged artifact end to end, so
+    a torn or bit-flipped ship is REJECTED at deserialize (the
+    ``torn_weights`` verdict) and can never be staged into an engine."""
+    names = sorted(params)
+    arrays = [np.ascontiguousarray(np.asarray(params[n])) for n in names]
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(a.tobytes(), crc)
+    meta = {
+        "format": WEIGHT_FORMAT,
+        "version": int(version),
+        "names": names,
+        "crc32": crc & 0xFFFFFFFF,
+    }
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        ),
+        **{f"w{i:04d}": a for i, a in enumerate(arrays)},
+    )
+    return buf.getvalue()
+
+
+def deserialize_weights(data: bytes) -> tuple[int, dict]:
+    """bytes -> (version, {name: array}); raises ValueError on a
+    foreign format OR a CRC mismatch — a torn weight ship must die
+    here, loudly, never half-staged."""
+    with np.load(io.BytesIO(data)) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        if meta.get("format") != WEIGHT_FORMAT:
+            raise ValueError(
+                f"weight_ship format {meta.get('format')!r} != "
+                f"{WEIGHT_FORMAT!r}"
+            )
+        names = list(meta["names"])
+        arrays = [np.ascontiguousarray(z[f"w{i:04d}"])
+                  for i in range(len(names))]
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(a.tobytes(), crc)
+    if (crc & 0xFFFFFFFF) != int(meta["crc32"]):
+        raise ValueError(
+            f"torn weight_ship v{meta.get('version')}: CRC mismatch "
+            f"({crc & 0xFFFFFFFF:#010x} != {int(meta['crc32']):#010x})"
+        )
+    return int(meta["version"]), dict(zip(names, arrays))

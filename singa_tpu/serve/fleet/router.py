@@ -43,6 +43,9 @@ from ..kv_pool import PrefixCache
 PREFILL_CAPABLE = ("prefill", "unified")
 #: roles that accept migrated sequences (run the decode tick)
 DECODE_CAPABLE = ("decode", "unified")
+#: every serving role — the set whose statuses carry a weight version
+#: (Router.versions; drained tombstones fall outside it)
+ROLES_WITH_VERSION = ("prefill", "decode", "unified")
 
 #: cap on published cached-digest lists (a snapshot is feedback, not a
 #: replica of the index; 4096 16-byte digests ~ 64 KiB of hex)
@@ -153,6 +156,20 @@ class Router:
             host, "request", encode_request(req), src=self.name
         )
         return host
+
+    def versions(self) -> dict[str, int]:
+        """Per-host weight version off published statuses (the live
+        rollout's skew view: during a canary or a paused promotion the
+        fleet is legitimately mixed-version, and the migration /
+        cache-ship paths degrade any cross-version frame to cold
+        prefill rather than splice two models into one stream). Hosts
+        that predate the rollout channel publish no version and read
+        as 0 — the pre-rollout contract."""
+        return {
+            s["host"]: int(s.get("version", 0))
+            for s in self.transport.statuses().values()
+            if s.get("host") and s.get("role") in ROLES_WITH_VERSION
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -32,7 +32,10 @@ MigratedSequence), ``request`` (a JSON-encoded generation request),
 JSON-encoded prefix-digest chain a host wants a peer's warm blocks
 for) and its bulk reply ``cache_ship`` (the matched blocks' per-layer
 K/V bytes as ONE frame — the fleet prefix cache,
-serve/fleet/migrate.py), ``shutdown`` (empty payload). ``status`` is
+serve/fleet/migrate.py), ``weight_ship`` (a next-version param tree as
+ONE CRC-guarded bulk frame) and ``rollout`` (the JSON control channel
+driving stage/flip/rollback and their acks — the live weight rollout,
+serve/rollout.py), ``shutdown`` (empty payload). ``status`` is
 NOT a message — it rides the latest-wins ``publish``/``statuses``
 side channel so a slow consumer never backs up the feedback loop.
 """
@@ -51,7 +54,7 @@ from ...resilience.coord import atomic_write_bytes
 #: message kinds the fleet speaks
 KINDS = (
     "migrate", "request", "result", "shutdown",
-    "cache_fetch", "cache_ship",
+    "cache_fetch", "cache_ship", "weight_ship", "rollout",
 )
 
 

@@ -327,6 +327,26 @@ class PrefixCache:
                 del self._tails_of[block]
         return block
 
+    def clear(self) -> int:
+        """Drop EVERY index entry, full-block and partial-tail alike ->
+        how many full-block entries were dropped. Cached KV bytes are a
+        function of the weights that wrote them, so a weight hot-swap
+        (serve/rollout.py) must invalidate the whole index: a block
+        prefilled under the old version matching a new-version admission
+        would poison the pool."""
+        n = len(self._by_digest)
+        if n or self._tail_block:
+            self.version += 1
+        self._by_digest.clear()
+        self._digest_of.clear()
+        self._parent.clear()
+        self._children.clear()
+        self._tail_block.clear()
+        self._tails_of.clear()
+        self._tail_parent.clear()
+        self._tail_children.clear()
+        return n
+
     def register(self, digest: bytes, block: int,
                  parent: bytes | None = None) -> bool:
         """Bind ``digest`` -> ``block`` (``parent`` = the previous
@@ -453,6 +473,24 @@ class BlockAllocator:
 
     def can_alloc(self, n: int) -> bool:
         return n <= self.free_blocks
+
+    def purge_cache(self) -> int:
+        """Invalidate the whole prefix cache: drop every index entry and
+        return every LRU-parked refcount-0 block to the free list -> how
+        many full-block index entries were dropped. The weight-rollout
+        flip (serve/rollout.py) calls this at the tick boundary: cached
+        K/V bytes were written under the OLD weights, so under the new
+        version every warm block is garbage. Blocks still referenced by
+        live sequences merely lose their index entries — their in-flight
+        owners keep decoding over them, and release() returns them to
+        the free list (no longer cached) at retirement."""
+        if self.cache is None:
+            return 0
+        dropped = self.cache.clear()
+        while self._lru:
+            block, _ = self._lru.popitem(last=False)
+            self._free.append(block)
+        return dropped
 
     def headroom_excluding(self, blocks: list[int]) -> int:
         """Allocatable count once ``blocks`` are retained: their LRU

@@ -180,6 +180,21 @@ def build_argparser() -> argparse.ArgumentParser:
                     "preemption plane fires — it drains its in-flight "
                     "sequences TO A PEER and the fleet finishes "
                     "without it; exit 75, streams still identical")
+    ap.add_argument("--rollout", default="off",
+                    choices=("off", "promote", "parity_fail"),
+                    help="live weight-rollout drill (serve/rollout.py): "
+                    "serve the workload on a --fleet_hosts fleet and "
+                    "hot-swap a NEW weight version mid-bench (canary -> "
+                    "parity -> promote). 'promote' expects verdict "
+                    "promoted; 'parity_fail' perturbs one expected "
+                    "probe token so the health gate trips and expects "
+                    "the automatic fleet-wide rollback. Gate: streams "
+                    "retired BEFORE the flip tick are bitwise the "
+                    "no-rollout oracle, zero streams drop or hang, and "
+                    "every host lands on the expected version")
+    ap.add_argument("--rollout_at_tick", type=int, default=8,
+                    help="with --rollout: fleet rounds served on the "
+                    "current version before the controller starts")
     ap.add_argument("--arrival", default="batch",
                     choices=("batch", "poisson"),
                     help="'poisson' adds a seeded open-loop arrival "
@@ -928,6 +943,159 @@ def _fleet_prefix_main(args, params, cfg, prompts) -> int:
     return 0 if out["pass"] else 1
 
 
+def _rollout_main(args, params, cfg, prompts) -> int:
+    """The --rollout drill: live weight hot-swap under load
+    (serve/rollout.py). One fleet serves the workload; at
+    --rollout_at_tick the controller stages a NEW version, canaries one
+    decode host, parity-probes it, and promotes (or — parity_fail —
+    trips the health gate and rolls the fleet back). The oracle is the
+    identical fleet run with NO rollout: every stream retired BEFORE
+    the canary flip must match it bitwise (flip identity — a hot-swap
+    may only change streams that outlive it), every stream must finish
+    (zero drops/hangs), and every host must land on the expected
+    version."""
+    import jax
+    import numpy as np
+
+    from ..models.transformer import init_lm
+    from ..serve import Request
+    from ..serve.fleet.router import DECODE_CAPABLE
+    from ..serve.rollout import RolloutController
+
+    def serve(hosts, router, *, stop_after=None):
+        """Submit the whole workload, tick until done (or until
+        ``stop_after`` fleet rounds — mid-flight). -> rounds run."""
+        for i, pr in enumerate(prompts):
+            router.submit(Request(
+                rid=i, prompt=np.asarray(pr, np.int32),
+                max_new_tokens=args.max_new, seed=args.seed + i,
+            ))
+        return pump(hosts, stop_after=stop_after)
+
+    def pump(hosts, *, stop_after=None):
+        idle = rounds = 0
+        for _ in range(10 ** 5):
+            if stop_after is not None and rounds >= stop_after:
+                return rounds
+            for h in hosts:
+                h.tick()
+            rounds += 1
+            idle = idle + 1 if not any(h.busy for h in hosts) else 0
+            if idle >= 3:
+                return rounds
+        raise RuntimeError("rollout drill stalled")
+
+    def warm(hosts, router):
+        # compile-warm every host off the clock (run_fleet's pattern)
+        per_wave = max(
+            1, sum(1 for h in hosts if h.role in DECODE_CAPABLE)
+        )
+        for k in range(per_wave):
+            router.submit(Request(
+                rid=-1 - k, prompt=np.asarray(prompts[0], np.int32),
+                max_new_tokens=2,
+            ))
+        pump(hosts)
+        for h in hosts:
+            h.sched.finished.clear()
+            h.sched.reset_counters()
+
+    def streams_of(hosts):
+        return {
+            r.rid: list(r.tokens)
+            for h in hosts for r in h.sched.finished if r.rid >= 0
+        }
+
+    # the no-rollout oracle: same fleet build, same workload
+    o_hosts, o_router, _ = build_fleet(params, cfg, args)
+    warm(o_hosts, o_router)
+    serve(o_hosts, o_router)
+    oracle = streams_of(o_hosts)
+
+    # the measured run: identical fleet, hot-swapped mid-bench
+    hosts, router, transport = build_fleet(params, cfg, args)
+    warm(hosts, router)
+    recorders = ctl_rec = None
+    if args.workspace:
+        import os
+
+        from ..obs.recorder import FlightRecorder
+
+        events = os.path.join(args.workspace, "events")
+        recorders = [
+            FlightRecorder(events, rank=i, run_id="serve_bench_rollout")
+            for i in range(len(hosts))
+        ]
+        for h, rec in zip(hosts, recorders):
+            h.sched.recorder = rec
+            h._event("fleet_role", host=h.name, role=h.role)
+        ctl_rec = FlightRecorder(
+            events, rank=len(hosts), run_id="serve_bench_rollout",
+        )
+        ctl_rec.event("run_start", step=0, mode="serve_bench_rollout")
+    next_params = init_lm(jax.random.PRNGKey(args.seed + 1), cfg)
+    serve(hosts, router, stop_after=args.rollout_at_tick)
+    # everything finished BEFORE the controller starts is provably
+    # pre-flip: the flip-identity set the gate pins bitwise
+    pre_flip = set(streams_of(hosts))
+    ctl = RolloutController(
+        transport, {h.name: h.role for h in hosts},
+        params=next_params, version=1, cfg=cfg,
+        serving=hosts[0].engine.serving,
+        probes=2, probe_tokens=4, stage_timeout_s=60.0,
+        recorder=ctl_rec,
+        force_parity_fail=args.rollout == "parity_fail",
+        tick=lambda: [h.tick() for h in hosts],
+        log=lambda s: print(s, file=sys.stderr),
+    )
+    res = ctl.run()
+    pump(hosts)  # drain the remaining streams to completion
+    streams = streams_of(hosts)
+
+    want_verdict = (
+        "promoted" if args.rollout == "promote" else "rollback"
+    )
+    want_version = 1 if args.rollout == "promote" else 0
+    pre_mismatches = sum(
+        1 for i in pre_flip if streams.get(i) != oracle.get(i)
+    )
+    hung = sorted(set(range(len(prompts))) - set(streams))
+    versions = {h.name: h.engine.params_version for h in hosts}
+    out = {
+        "rollout": args.rollout,
+        "fleet_hosts": args.fleet_hosts,
+        "requests": len(prompts),
+        "finished": len(streams),
+        "hung": len(hung),
+        "verdict": res["verdict"],
+        "want_verdict": want_verdict,
+        "rollbacks": res["rollbacks"],
+        "torn_ships": res["torn_ships"],
+        "canary": res["canary"],
+        "versions": versions,
+        "pre_flip_streams": len(pre_flip),
+        "pre_flip_mismatches": pre_mismatches,
+        "rollout_at_tick": args.rollout_at_tick,
+    }
+    out["pass"] = (
+        res["verdict"] == want_verdict
+        and not hung
+        and pre_mismatches == 0
+        and all(v == want_version for v in versions.values())
+    )
+    if recorders:
+        for i, rec in enumerate(recorders):
+            rec.event(
+                "run_stop", step=hosts[i].sched.ticks, exit_code=0,
+            )
+            rec.close()
+        ctl_rec.close()
+    print(json.dumps(out))
+    if args.no_gate:
+        return 0
+    return 0 if out["pass"] else 1
+
+
 def _fleet_main(args, params, cfg, prompts) -> int:
     """The --fleet drill: role-split hosts behind the front-door
     router vs ONE unified host at the same per-host slots (which is
@@ -1091,6 +1259,11 @@ def main(argv=None) -> int:
     )
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
     prompts = _workload(args)
+
+    if args.rollout != "off":
+        # the live weight-rollout drill owns its whole flow (fleet
+        # build, oracle, controller, flip-identity gate)
+        return _rollout_main(args, params, cfg, prompts)
 
     if args.fleet:
         # the disaggregated-fleet drill owns its whole flow (its own

@@ -429,6 +429,47 @@ def summarize(records: list[dict]) -> dict:
             },
         }
 
+    # live weight rollout (serve/rollout.py): per-host flip history
+    # keyed by rank from the cross-rank merge, weight-ship volume,
+    # canary parity verdict, aborts/rollbacks, final verdict
+    flip_events = [
+        r for r in life
+        if r.get("kind") == "rollout_flip"
+        and isinstance(r.get("data"), dict)
+    ]
+    weight_ships = [
+        r["data"] for r in life
+        if r.get("kind") == "weight_ship"
+        and isinstance(r.get("data"), dict)
+    ]
+    rollout_aborts = [
+        r["data"] for r in life
+        if r.get("kind") == "rollout_abort"
+        and isinstance(r.get("data"), dict)
+    ]
+    rollout_canary = [
+        r["data"] for r in life
+        if r.get("kind") == "rollout_canary"
+        and isinstance(r.get("data"), dict)
+    ]
+    rollout_done = [
+        r["data"] for r in life
+        if r.get("kind") == "rollout_done"
+        and isinstance(r.get("data"), dict)
+    ]
+    rollout_hosts: dict[str, dict] = {}
+    for r in flip_events:
+        d = r["data"]
+        e = rollout_hosts.setdefault(str(int(r.get("rank", 0))), {
+            "version": 0, "flip_tick": None, "flips": 0,
+            "rollbacks": 0,
+        })
+        e["flips"] += 1
+        e["version"] = int(d.get("version", 0))
+        e["flip_tick"] = d.get("tick")
+        if d.get("rollback"):
+            e["rollbacks"] += 1
+
     faults = [
         r["data"].get("fault")
         for r in life
@@ -593,6 +634,58 @@ def summarize(records: list[dict]) -> dict:
         if (
             request_ms or ticks or counts.get("request_admit")
             or fleet_roles or counts.get("route")
+        )
+        else None,
+        # live weight rollout (None unless rollout/weight_ship events
+        # are present): ship volume counted on the receiver, torn-frame
+        # rejections, per-rank flip history, canary parity verdict,
+        # aborts with their documented reasons, and the controller's
+        # final verdict (promoted / rollback / quarantined / paused)
+        "rollout": {
+            "ships_in": sum(
+                1 for s in weight_ships
+                if s.get("dir") == "in" and s.get("ok")
+            ),
+            "ship_bytes_in": sum(
+                int(s.get("bytes", 0)) for s in weight_ships
+                if s.get("dir") == "in" and s.get("ok")
+            ),
+            "torn_ships": sum(
+                1 for s in weight_ships
+                if s.get("dir") == "in" and not s.get("ok", True)
+            ),
+            "stages": counts.get("rollout_stage", 0),
+            "flips": sum(
+                1 for r in flip_events
+                if not r["data"].get("rollback")
+            ),
+            "rollbacks": sum(
+                1 for r in flip_events if r["data"].get("rollback")
+            ),
+            "canary": {
+                "parity": bool(rollout_canary[-1].get("parity")),
+                "probes": int(rollout_canary[-1].get("probes", 0)),
+            }
+            if rollout_canary
+            else None,
+            "aborts": [
+                {
+                    "reason": a.get("reason"),
+                    "version": a.get("version"),
+                }
+                for a in rollout_aborts
+            ],
+            "verdict": rollout_done[-1].get("verdict")
+            if rollout_done
+            else None,
+            "version": rollout_done[-1].get("version")
+            if rollout_done
+            else None,
+            "hosts": rollout_hosts or None,
+        }
+        if (
+            flip_events or weight_ships or rollout_done
+            or rollout_aborts or counts.get("rollout_stage")
         )
         else None,
         # wire transport (None unless wire_* events are present — the

@@ -216,6 +216,42 @@ def test_cost001_fraction_arms(shard):
         assert ("COST001" in _codes(col)) == fires, (frac, col.sorted())
 
 
+def test_rol001_dual_resident_stage_window(shard):
+    """A live rollout stages a SECOND param tree: a budget the
+    steady-state footprint fits but footprint + params does not must
+    fire the ROL001 headroom arm — and only when a rollout is actually
+    configured, and never stacked on top of a plain MEM001 overflow."""
+    ro = 'fleet { rollout { checkpoint: "ck.npz" version: 2 } }\n'
+    cfg = _cfg(shard, extra=ro)
+    report = build_cost_model(cfg, {"data": 2}, "t.conf")
+    assert report is not None and report.param_bytes > 1
+    budget = report.hbm_bytes + report.param_bytes // 2
+    cl, widths, _ = _cluster(CLUSTER2 + f"device_hbm_bytes: {budget}\n")
+    col = Collector()
+    cost_rules(cfg, cl, widths, "t.conf", col)
+    hits = [d for d in col.sorted() if d.code == "ROL001"]
+    assert len(hits) == 1 and hits[0].severity == "ERROR"
+    assert "second resident param tree" in hits[0].msg
+    assert "stage window" in hits[0].msg
+    assert "MEM001" not in _codes(col)
+    # no rollout configured -> the same squeeze is silent
+    col = Collector()
+    cost_rules(_cfg(shard), cl, widths, "t.conf", col)
+    assert "ROL001" not in _codes(col)
+    # headroom for the staged tree -> silent
+    roomy = report.hbm_bytes + 2 * report.param_bytes
+    cl, widths, _ = _cluster(CLUSTER2 + f"device_hbm_bytes: {roomy}\n")
+    col = Collector()
+    cost_rules(cfg, cl, widths, "t.conf", col)
+    assert "ROL001" not in _codes(col)
+    # steady-state overflow is MEM001's story alone — no double report
+    tight = report.hbm_bytes - 1
+    cl, widths, _ = _cluster(CLUSTER2 + f"device_hbm_bytes: {tight}\n")
+    col = Collector()
+    cost_rules(cfg, cl, widths, "t.conf", col)
+    assert "MEM001" in _codes(col) and "ROL001" not in _codes(col)
+
+
 # ---------------------------------------------------------------------------
 # SRV002 / FLT002 (config-only arms: no net build, no shard on disk)
 # ---------------------------------------------------------------------------
